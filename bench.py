@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Headline benchmark: iso3dfd order-16 (radius 8) single-device throughput.
+
+Mirrors the reference harness' trial protocol (``yask_main.cpp:53-66``):
+warmup (excluded, covers XLA compile), then N timed trials; report the
+"mid" (median) throughput in GPts/s — the reference's primary fitness
+metric (``context.cpp:449-460``, ``YaskUtils.pm:40``).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GPts/s", "vs_baseline": N}
+vs_baseline is measured against the BASELINE.md target of 500 GPts/s/chip.
+"""
+
+import json
+import sys
+import time
+
+
+def main():
+    import jax
+    import numpy as np
+    from yask_tpu import yk_factory
+
+    fac = yk_factory()
+    env = fac.new_env()
+    platform = env.get_platform()
+
+    # Pick the largest domain that fits; 512^3 is the reference's
+    # single-device headline config (BASELINE.md).
+    sizes = [512, 384, 256] if platform == "tpu" else [128]
+    steps_per_trial = 10 if platform == "tpu" else 2
+    trials = 3
+
+    last_err = None
+    for g in sizes:
+        try:
+            ctx = fac.new_solution(env, stencil="iso3dfd", radius=8)
+            ctx.apply_command_line_options(f"-g {g}")
+            ctx.prepare_solution()
+            ctx.get_var("pressure").set_element(
+                1.0, [0, g // 2, g // 2, g // 2])
+            ctx.get_var("vel").set_all_elements_same(0.1)
+
+            # Warmup: compiles the chunk and runs it once.
+            ctx.run_solution(0, steps_per_trial - 1)
+            ctx.clear_stats()
+
+            rates = []
+            t = steps_per_trial
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                ctx.run_solution(t, t + steps_per_trial - 1)
+                dt = time.perf_counter() - t0
+                t += steps_per_trial
+                rates.append(g ** 3 * steps_per_trial / dt / 1e9)
+            rates.sort()
+            mid = rates[len(rates) // 2]
+
+            # sanity: field stayed finite
+            s = ctx.get_var("pressure").get_elements_in_slice(
+                [t, g // 2 - 1, g // 2 - 1, g // 2 - 1],
+                [t, g // 2 + 1, g // 2 + 1, g // 2 + 1])
+            if not np.isfinite(s).all():
+                raise RuntimeError("non-finite field")
+
+            print(json.dumps({
+                "metric": f"iso3dfd r=8 {g}^3 fp32 {platform} throughput",
+                "value": round(mid, 3),
+                "unit": "GPts/s",
+                "vs_baseline": round(mid / 500.0, 4),
+            }))
+            return 0
+        except Exception as e:  # try a smaller domain
+            last_err = e
+    print(json.dumps({
+        "metric": "iso3dfd bench failed",
+        "value": 0.0,
+        "unit": "GPts/s",
+        "vs_baseline": 0.0,
+        "error": str(last_err)[:200],
+    }))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

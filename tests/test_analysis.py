@@ -1,0 +1,171 @@
+"""Tests for equation analysis: validity rules, dependencies, parts/stages,
+halos, scratch propagation, lifespans (the behaviors of Eqs.cpp the reference
+exercises through its stencil test suite)."""
+
+import pytest
+
+from yask_tpu.compiler.solution import yc_factory
+from yask_tpu.utils.exceptions import YaskException
+
+
+def new_soln(name="s"):
+    soln = yc_factory().new_solution(name)
+    t = soln.new_step_index("t")
+    x = soln.new_domain_index("x")
+    y = soln.new_domain_index("y")
+    return soln, t, x, y
+
+
+def test_halo_and_step_dir():
+    soln, t, x, y = new_soln()
+    u = soln.new_var("u", [t, x, y])
+    u(t + 1, x, y).EQUALS(u(t, x - 2, y) + u(t, x + 3, y) + u(t, x, y - 1))
+    ana = soln.analyze()
+    assert ana.step_dir == 1
+    assert u.halo["x"] == (2, 3)
+    assert u.halo["y"] == (1, 0)
+    assert u.get_step_alloc_size() == 2
+
+
+def test_reverse_step_dir():
+    soln, t, x, y = new_soln()
+    u = soln.new_var("u", [t, x, y])
+    u(t - 1, x, y).EQUALS(u(t, x + 1, y) * 0.5)
+    ana = soln.analyze()
+    assert ana.step_dir == -1
+
+
+def test_mixed_step_dir_rejected():
+    soln, t, x, y = new_soln()
+    u = soln.new_var("u", [t, x, y])
+    v = soln.new_var("v", [t, x, y])
+    u(t + 1, x, y).EQUALS(u(t, x, y))
+    v(t - 1, x, y).EQUALS(v(t, x, y))
+    with pytest.raises(YaskException):
+        soln.analyze()
+
+
+def test_lhs_rules():
+    soln, t, x, y = new_soln()
+    u = soln.new_var("u", [t, x, y])
+    u(t + 1, x + 1, y).EQUALS(u(t, x, y))   # offset LHS domain index
+    with pytest.raises(YaskException):
+        soln.analyze()
+
+    soln2, t2, x2, y2 = new_soln("s2")
+    w = soln2.new_var("w", [t2, x2, y2])
+    w(t2 + 2, x2, y2).EQUALS(w(t2, x2, y2))  # step offset 2
+    with pytest.raises(YaskException):
+        soln2.analyze()
+
+
+def test_intra_step_race_rejected_and_override():
+    soln, t, x, y = new_soln()
+    u = soln.new_var("u", [t, x, y])
+    u(t + 1, x, y).EQUALS(u(t + 1, x - 1, y) + 1.0)  # reads own new value
+    with pytest.raises(YaskException):
+        soln.analyze()
+    # the reference allows disabling the checker
+    # (set_dependency_checker_enabled, yask_compiler_api.hpp:575)
+    soln._analysis = None
+    soln.set_dependency_checker_enabled(False)
+    soln.analyze()
+
+
+def test_same_step_dependency_makes_stages():
+    soln, t, x, y = new_soln()
+    a = soln.new_var("a", [t, x, y])
+    b = soln.new_var("b", [t, x, y])
+    a(t + 1, x, y).EQUALS(a(t, x, y) + b(t, x, y))
+    b(t + 1, x, y).EQUALS(a(t + 1, x - 1, y) * 2.0)   # reads new a
+    ana = soln.analyze()
+    assert len(ana.stages) == 2
+    first = ana.stages[0].parts[0].eqs[0].lhs.var_name()
+    assert first == "a"
+    # b needs fresh ghosts of the newly computed a before stage 2
+    # (recorded for the exchange planner)
+
+
+def test_circular_same_step_dependency_rejected():
+    soln, t, x, y = new_soln()
+    a = soln.new_var("a", [t, x, y])
+    b = soln.new_var("b", [t, x, y])
+    a(t + 1, x, y).EQUALS(b(t + 1, x, y) + 1.0)
+    b(t + 1, x, y).EQUALS(a(t + 1, x, y) + 1.0)
+    with pytest.raises(YaskException):
+        soln.analyze()
+
+
+def test_waw_ordering_preserves_registration_order():
+    soln, t, x, y = new_soln()
+    u = soln.new_var("u", [t, x, y])
+    nfirst = u(t + 1, x, y).EQUALS(u(t, x, y) + 1.0)
+    override = u(t + 1, x, y).EQUALS(0.0).IF_DOMAIN(x < 2)
+    ana = soln.analyze()
+    # the conditional override must be in a later (or same-order later) part
+    order = []
+    for st in ana.stages:
+        for p in st.parts:
+            order.extend(p.eqs)
+    assert order.index(soln.get_equations()[0]) < \
+        order.index(soln.get_equations()[1])
+
+
+def test_scratch_halo_propagation():
+    soln, t, x, y = new_soln()
+    u = soln.new_var("u", [t, x, y])
+    s = soln.new_scratch_var("s", [x, y])
+    # s computed from u with radius 1; u(t+1) reads s at radius 2
+    s(x, y).EQUALS(u(t, x - 1, y) + u(t, x + 1, y))
+    u(t + 1, x, y).EQUALS(s(x - 2, y) + s(x + 2, y))
+    ana = soln.analyze()
+    # s must be computed over domain±2 (write-halo)
+    assert ana.scratch_write_halo["s"]["x"] == (2, 2)
+    # u's halo must cover write-halo(2) + its own read offset(1) = 3
+    assert u.halo["x"][0] >= 3 and u.halo["x"][1] >= 3
+    # scratch part runs in the same stage as its consumer
+    assert len(ana.stages) == 1
+    assert ana.stages[0].parts[0].is_scratch
+
+
+def test_scratch_rules():
+    soln, t, x, y = new_soln()
+    with pytest.raises(YaskException):
+        soln.new_scratch_var("bad", [t, x, y])  # scratch can't have step dim
+
+
+def test_misc_dims():
+    soln, t, x, y = new_soln()
+    c = soln.new_misc_index("c")
+    u = soln.new_var("u", [t, x, y])
+    k = soln.new_var("k", [c, x, y])
+    u(t + 1, x, y).EQUALS(k(0, x, y) * u(t, x - 1, y)
+                          + k(2, x, y) * u(t, x + 1, y))
+    ana = soln.analyze()
+    assert k.misc_range["c"] == (0, 2)
+    with pytest.raises(YaskException):
+        k(c, x, y)  # misc dim must be a constant index
+
+
+def test_pointwise_ring_reduction():
+    # pure pointwise map needs only 1 ring slot (write-back optimization)
+    soln, t, x, y = new_soln()
+    u = soln.new_var("u", [t, x, y])
+    u(t + 1, x, y).EQUALS(u(t, x, y) * 0.9)
+    soln.analyze()
+    assert u.get_step_alloc_size() == 1
+
+    # 2nd-order-in-time with pointwise extreme read → 2 slots, not 3
+    soln2, t2, x2, y2 = new_soln("s2")
+    p = soln2.new_var("p", [t2, x2, y2])
+    p(t2 + 1, x2, y2).EQUALS(2.0 * p(t2, x2, y2) - p(t2 - 1, x2, y2)
+                             + p(t2, x2 - 1, y2))
+    soln2.analyze()
+    assert p.get_step_alloc_size() == 2
+
+    # but a spatial read at the extreme offset forces the full span
+    soln3, t3, x3, y3 = new_soln("s3")
+    q = soln3.new_var("q", [t3, x3, y3])
+    q(t3 + 1, x3, y3).EQUALS(q(t3, x3, y3) - q(t3 - 1, x3 - 1, y3))
+    soln3.analyze()
+    assert q.get_step_alloc_size() == 3

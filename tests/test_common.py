@@ -1,0 +1,112 @@
+"""Tests for the common substrate: CLI parser, FD coefficients, outputs,
+exceptions (reference api/unit tests for src/common)."""
+
+import math
+import os
+
+import pytest
+
+from yask_tpu.utils.cli import CommandLineParser
+from yask_tpu.utils.exceptions import YaskException
+from yask_tpu.utils.fd_coeff import (
+    get_center_fd_coefficients,
+    get_forward_fd_coefficients,
+    get_backward_fd_coefficients,
+    get_arbitrary_fd_coefficients,
+)
+from yask_tpu.utils.idx_tuple import IdxTuple
+from yask_tpu.utils.output import yask_output_factory
+
+
+class Cfg:
+    def __init__(self):
+        self.flag = False
+        self.n = 1
+        self.rate = 0.5
+        self.name = "a"
+        self.names = []
+        self.sizes = IdxTuple(x=0, y=0)
+
+
+def make_parser(cfg):
+    p = CommandLineParser()
+    p.add_bool_option("flag", "A flag.", cfg, "flag")
+    p.add_int_option("n", "An int.", cfg, "n")
+    p.add_float_option("rate", "A float.", cfg, "rate")
+    p.add_string_option("name", "A string.", cfg, "name")
+    p.add_string_list_option("names", "A list.", cfg, "names")
+    p.add_idx_option("s", "Sizes.", cfg, "sizes")
+    return p
+
+
+def test_parser_types_and_leftovers():
+    cfg = Cfg()
+    p = make_parser(cfg)
+    rest = p.parse_args(["-flag", "-n", "7", "-rate", "0.25", "-name", "bob",
+                         "-names", "a,b,c", "-s", "64", "-s_y", "32",
+                         "positional", "-unknown", "v"])
+    assert cfg.flag is True and cfg.n == 7 and cfg.rate == 0.25
+    assert cfg.name == "bob" and cfg.names == ["a", "b", "c"]
+    assert cfg.sizes["x"] == 64 and cfg.sizes["y"] == 32
+    assert rest == ["positional", "-unknown", "v"]
+
+
+def test_parser_bool_negation_and_errors():
+    cfg = Cfg()
+    p = make_parser(cfg)
+    p.parse_args(["-flag"])
+    assert cfg.flag
+    p.parse_args(["-no-flag"])
+    assert not cfg.flag
+    with pytest.raises(YaskException):
+        p.parse_args(["-n"])          # missing value
+    with pytest.raises(YaskException):
+        p.parse_args(["-n", "abc"])   # bad int
+    help_text = p.print_help()
+    assert "-[no-]flag" in help_text and "-s <val>" in help_text
+
+
+def test_fd_center_second_derivative():
+    # r=1: the classic [1, -2, 1]
+    c = get_center_fd_coefficients(2, 1)
+    assert c == pytest.approx([1.0, -2.0, 1.0])
+    # r=2: [-1/12, 4/3, -5/2, 4/3, -1/12]
+    c = get_center_fd_coefficients(2, 2)
+    assert c == pytest.approx([-1 / 12, 4 / 3, -5 / 2, 4 / 3, -1 / 12])
+
+
+def test_fd_first_derivative_forms():
+    assert get_center_fd_coefficients(1, 1) == pytest.approx([-0.5, 0, 0.5])
+    assert get_forward_fd_coefficients(1, 1) == pytest.approx([-1.0, 1.0])
+    assert get_backward_fd_coefficients(1, 1) == pytest.approx([-1.0, 1.0])
+    # staggered 4th-order: ±1/24, ∓9/8 pattern
+    c = get_arbitrary_fd_coefficients(1, 0.0, [-1.5, -0.5, 0.5, 1.5])
+    assert c == pytest.approx([1 / 24, -9 / 8, 9 / 8, -1 / 24])
+
+
+def test_fd_errors():
+    with pytest.raises(YaskException):
+        get_center_fd_coefficients(2, 0)
+    with pytest.raises(YaskException):
+        get_arbitrary_fd_coefficients(3, 0.0, [0.0, 1.0])  # too few points
+
+
+def test_outputs(tmp_path):
+    fac = yask_output_factory()
+    s = fac.new_string_output()
+    s.write("hello")
+    assert s.get_string() == "hello"
+    s.discard()
+    assert s.get_string() == ""
+    f = fac.new_file_output(str(tmp_path / "o.txt"))
+    f.write("data")
+    f.close()
+    assert (tmp_path / "o.txt").read_text() == "data"
+    fac.new_null_output().write("dropped")
+
+
+def test_exception_accretion():
+    e = YaskException("base")
+    e.add_message(" more")
+    assert e.get_message() == "base more"
+    assert "more" in str(e)

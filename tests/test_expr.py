@@ -1,0 +1,121 @@
+"""Tests for the expression AST and node factory (the analog of the
+reference's compiler API tests, ``src/compiler/tests/yask_compiler_api_test``:
+exercise every node type + exception paths)."""
+
+import pytest
+
+from yask_tpu.compiler import expr as E
+from yask_tpu.compiler.node_api import yc_node_factory
+from yask_tpu.compiler.solution import yc_factory
+from yask_tpu.utils.exceptions import YaskException
+
+
+def make_soln():
+    soln = yc_factory().new_solution("test")
+    t = soln.new_step_index("t")
+    x = soln.new_domain_index("x")
+    y = soln.new_domain_index("y")
+    u = soln.new_var("u", [t, x, y])
+    return soln, t, x, y, u
+
+
+def test_operator_overloading_builds_ast():
+    soln, t, x, y, u = make_soln()
+    e = 2.0 * u(t, x, y) + u(t, x + 1, y) / 3.0 - u(t, x, y - 2)
+    assert isinstance(e, E.NumExpr)
+    s = e.format_simple()
+    assert "u(t, x+1, y)" in s and "u(t, x, y-2)" in s
+
+
+def test_const_folding_in_commutative():
+    e = E.AddExpr.make([E.ConstExpr(1), E.ConstExpr(2), E.ConstExpr(3)])
+    assert isinstance(e, E.ConstExpr) and e.value == 6.0
+    m = E.MultExpr.make([E.ConstExpr(2), E.ConstExpr(4)])
+    assert m.value == 8.0
+
+
+def test_decompose_index_arg():
+    soln, t, x, y, u = make_soln()
+    assert E.decompose_index_arg(x) == ("x", 0)
+    assert E.decompose_index_arg(x + 3) == ("x", 3)
+    assert E.decompose_index_arg(x - 2) == ("x", -2)
+    assert E.decompose_index_arg(5) == (None, 5)
+    with pytest.raises(YaskException):
+        E.decompose_index_arg(x + y)   # two indices
+    with pytest.raises(YaskException):
+        u(t, x * 2, y)                 # scaled index unsupported
+
+
+def test_var_point_validation():
+    soln, t, x, y, u = make_soln()
+    with pytest.raises(YaskException):
+        u(t, x)           # wrong arity
+    with pytest.raises(YaskException):
+        u(t, y, x)        # wrong index for dim
+    p = u(t + 1, x, y - 1)
+    assert p.step_offset() == 1
+    assert p.domain_offsets() == {"x": 0, "y": -1}
+
+
+def test_equals_auto_registration_and_conditions():
+    soln, t, x, y, u = make_soln()
+    eq = u(t + 1, x, y).EQUALS(u(t, x, y) * 0.5)
+    assert soln.get_num_equations() == 1
+    nfac = yc_node_factory()
+    eq2 = eq.IF_DOMAIN(x > nfac.new_first_domain_index(x))
+    # replacement, not addition
+    assert soln.get_num_equations() == 1
+    assert soln.get_equations()[0].cond is not None
+    eq3 = eq2.IF_STEP(E.IndexExpr("t", E.IndexType.STEP) >= 2)
+    assert soln.get_equations()[0].step_cond is not None
+
+
+def test_structural_identity_safe_in_dicts():
+    soln, t, x, y, u = make_soln()
+    a = u(t, x + 1, y)
+    b = u(t, x + 1, y)
+    assert a.same(b)
+    assert a.skey() == b.skey()
+    d = {a.skey(): 1}
+    assert b.skey() in d
+    # Python == builds an AST node, it must not be used for truth
+    with pytest.raises(YaskException):
+        bool(a == b)
+
+
+def test_counter_visitor():
+    soln, t, x, y, u = make_soln()
+    u(t + 1, x, y).EQUALS(
+        (u(t, x - 1, y) + u(t, x, y) + u(t, x + 1, y)) / 3.0)
+    c = E.CounterVisitor()
+    soln.get_equations()[0].accept(c)
+    assert c.num_reads == 3 and c.num_writes == 1
+    assert c.num_ops == 3  # two adds + one divide
+
+
+def test_node_factory_every_node():
+    nfac = yc_node_factory()
+    t = nfac.new_step_index("t")
+    x = nfac.new_domain_index("x")
+    c = nfac.new_const_number_node(2.5)
+    add = nfac.new_add_node(c, 1.0)
+    sub = nfac.new_subtract_node(add, 0.5)
+    mul = nfac.new_multiply_node(sub, 2.0)
+    div = nfac.new_divide_node(mul, 4.0)
+    neg = nfac.new_negate_node(div)
+    mod = nfac.new_mod_node(neg, 3.0)
+    fn = nfac.new_math_func_node("sqrt", [mod])
+    b1 = nfac.new_less_than_node(x, 5)
+    b2 = nfac.new_not_greater_than_node(x, 10)
+    band = nfac.new_and_node(b1, b2)
+    bor = nfac.new_or_node(band, nfac.new_not_node(b1))
+    assert isinstance(bor, E.OrExpr)
+    with pytest.raises(YaskException):
+        nfac.new_math_func_node("nosuchfn", [c])
+
+
+def test_math_helpers():
+    from yask_tpu.compiler.expr import sqrt, sin, cos, max_fn
+    soln, t, x, y, u = make_soln()
+    e = sqrt(u(t, x, y)) + sin(x) * cos(x) + max_fn(u(t, x, y), 0.0)
+    assert "sqrt" in e.format_simple()

@@ -1,0 +1,79 @@
+"""Unit tests for IdxTuple — the analog of the reference's tuple_test.cpp
+(``src/common/tests/tuple_test.cpp``, target ``tuple-test``)."""
+
+import pytest
+
+from yask_tpu.utils.idx_tuple import (
+    IdxTuple, parse_dim_val_str, n_choose_k, combination_at)
+from yask_tpu.utils.exceptions import YaskException
+
+
+def test_construction_and_access():
+    t = IdxTuple(x=4, y=5, z=6)
+    assert t.get_num_dims() == 3
+    assert t.get_dim_names() == ["x", "y", "z"]
+    assert t["y"] == 5
+    assert t[2] == 6
+    assert t.get_dim_posn("z") == 2
+    with pytest.raises(YaskException):
+        t["w"]
+
+
+def test_product_and_arith():
+    t = IdxTuple(x=4, y=5)
+    assert t.product() == 20
+    assert t.sum() == 9
+    u = t.add_elements(IdxTuple(x=1, y=2))
+    assert u.get_vals() == [5, 7]
+    v = t.mult_elements(2)
+    assert v.get_vals() == [8, 10]
+    assert (t - IdxTuple(x=1, y=1)).get_vals() == [3, 4]
+    assert t.max_elements(IdxTuple(x=10, y=0)).get_vals() == [10, 5]
+
+
+def test_layout_unlayout_roundtrip():
+    t = IdxTuple(x=3, y=4, z=5)
+    for i in range(t.product()):
+        pt = t.unlayout(i)
+        assert t.layout(pt) == i
+    # last dim is unit stride by default (TPU lanes convention)
+    s = t.strides()
+    assert s["z"] == 1 and s["y"] == 5 and s["x"] == 20
+    # first_inner flips it
+    t2 = IdxTuple({"x": 3, "y": 4}, first_inner=True)
+    assert t2.strides()["x"] == 1
+
+
+def test_layout_bounds():
+    t = IdxTuple(x=3)
+    with pytest.raises(YaskException):
+        t.layout(IdxTuple(x=3))
+    with pytest.raises(YaskException):
+        t.unlayout(3)
+
+
+def test_compact_factors():
+    t = IdxTuple(x=0, y=0)
+    f = t.get_compact_factors(12)
+    assert f.product() == 12
+    # compact: 3x4 (not 1x12)
+    assert sorted(f.get_vals()) == [3, 4]
+    f8 = IdxTuple(x=0, y=0, z=0).get_compact_factors(8)
+    assert f8.product() == 8
+    assert sorted(f8.get_vals()) == [2, 2, 2]
+
+
+def test_parse_and_format():
+    t = parse_dim_val_str("x=4, y=5")
+    assert t["x"] == 4 and t["y"] == 5
+    assert parse_dim_val_str(t.make_dim_val_str(sep=",")) == t
+    with pytest.raises(YaskException):
+        parse_dim_val_str("bogus")
+
+
+def test_combinatorics():
+    assert n_choose_k(5, 2) == 10
+    seen = {tuple(combination_at(4, 2, i)) for i in range(n_choose_k(4, 2))}
+    assert len(seen) == 6
+    with pytest.raises(YaskException):
+        combination_at(4, 2, 6)

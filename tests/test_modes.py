@@ -1,0 +1,118 @@
+"""Execution-mode equivalence: jit / sharded / shard_map / ref must agree —
+the analog of the reference's MPI test arg-sets (``src/kernel/Makefile:
+1044-1049``: same stencil run under varying rank layouts and compared to the
+scalar reference)."""
+
+import numpy as np
+import pytest
+
+from yask_tpu import yk_factory
+
+
+@pytest.fixture(scope="module")
+def env():
+    e = yk_factory().new_env()
+    if e.get_num_ranks() < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    return e
+
+
+def make_ssg(env, mode, ranks=(), g=24):
+    ctx = yk_factory().new_solution(env, stencil="ssg", radius=2)
+    ctx.apply_command_line_options(f"-g {g}")
+    ctx.get_settings().mode = mode
+    for d, n in ranks:
+        ctx.set_num_ranks(d, n)
+    ctx.prepare_solution()
+    rng = np.random.RandomState(7)
+    for name in ctx.get_var_names():
+        v = ctx.get_var(name)
+        if name == "rho":
+            v.set_all_elements_same(1.0)
+        elif name in ("lambda_", "mu"):
+            v.set_all_elements_same(0.01)
+        elif name.startswith("v_"):
+            arr = (rng.rand(g, g, g) * 0.1).astype(np.float32)
+            v.set_elements_in_slice(arr, [0, 0, 0, 0], [0, g-1, g-1, g-1])
+    ctx.run_solution(0, 3)
+    return ctx
+
+
+@pytest.fixture(scope="module")
+def ssg_ref(env):
+    return make_ssg(env, "ref")
+
+
+def test_jit_matches_ref(env, ssg_ref):
+    assert make_ssg(env, "jit").compare_data(ssg_ref) == 0
+
+
+def test_sharded_matches_ref(env, ssg_ref):
+    ctx = make_ssg(env, "sharded", ranks=[("x", 4)])
+    assert ctx.compare_data(ssg_ref) == 0
+
+
+def test_shard_map_1d_matches_ref(env, ssg_ref):
+    ctx = make_ssg(env, "shard_map", ranks=[("x", 4)])
+    assert ctx.compare_data(ssg_ref) == 0
+
+
+def test_shard_map_2d_mesh_matches_ref(env, ssg_ref):
+    ctx = make_ssg(env, "shard_map", ranks=[("x", 2), ("y", 4)])
+    assert ctx.compare_data(ssg_ref) == 0
+
+
+def test_shard_map_minor_dim_split(env, ssg_ref):
+    # splitting the minor-most dim exercises lane-adjacent ghost slabs
+    ctx = make_ssg(env, "shard_map", ranks=[("z", 2)])
+    assert ctx.compare_data(ssg_ref) == 0
+
+
+def test_auto_mode_selects_sharded(env):
+    ctx = yk_factory().new_solution(env, stencil="3axis", radius=1)
+    ctx.apply_command_line_options("-g 16")
+    ctx.set_num_ranks("x", 2)
+    ctx.prepare_solution()
+    assert ctx._mode == "sharded"
+
+
+def test_shard_geometry_validation(env):
+    from yask_tpu.utils.exceptions import YaskException
+    ctx = yk_factory().new_solution(env, stencil="3axis", radius=1)
+    ctx.apply_command_line_options("-g 18")   # not divisible by 4
+    ctx.get_settings().mode = "shard_map"
+    ctx.set_num_ranks("x", 4)
+    with pytest.raises(YaskException):
+        ctx.prepare_solution()
+
+
+def test_conditions_under_sharding(env):
+    """Sub-domain conditions use global coordinates, so the conditional
+    region must land identically however the domain is sharded."""
+    from yask_tpu.compiler.solution import yc_factory
+
+    def build():
+        soln = yc_factory().new_solution("cond")
+        t = soln.new_step_index("t")
+        x = soln.new_domain_index("x")
+        y = soln.new_domain_index("y")
+        u = soln.new_var("u", [t, x, y])
+        u(t + 1, x, y).EQUALS(u(t, x - 1, y) + 1.0).IF_DOMAIN(x >= 8)
+        u(t + 1, x, y).EQUALS(u(t, x, y)).IF_DOMAIN(x < 8)
+        return soln
+
+    def run(mode, ranks=()):
+        ctx = yk_factory().new_solution(env, build())
+        ctx.apply_command_line_options("-g 16")
+        ctx.get_settings().mode = mode
+        for d, n in ranks:
+            ctx.set_num_ranks(d, n)
+        ctx.prepare_solution()
+        ctx.get_var("u").set_elements_in_seq(0.1)
+        ctx.run_solution(0, 2)
+        return ctx
+
+    ref = run("ref")
+    assert run("jit").compare_data(ref) == 0
+    assert run("shard_map", [("x", 4)]).compare_data(ref) == 0
+    assert run("sharded", [("x", 4)]).compare_data(ref) == 0

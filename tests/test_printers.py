@@ -1,0 +1,58 @@
+"""Printer/output-format tests (reference debug printers: pseudo, dot;
+plus the py-api module emission — our analog of generated-code output)."""
+
+from yask_tpu.compiler.solution_base import create_solution
+from yask_tpu.utils.output import yask_output_factory
+
+
+def emit(name, target, radius=None):
+    sb = create_solution(name, radius=radius)
+    sb.get_soln().set_target(target)
+    out = yask_output_factory().new_string_output()
+    sb.get_soln().output_solution(out)
+    return out.get_string(), sb
+
+
+def test_pseudo():
+    text, _ = emit("3axis", "pseudo")
+    assert "Solution '3axis'" in text
+    assert "EQUALS" in text
+    assert "halo" in text
+
+
+def test_pseudo_long_has_analysis_detail():
+    text, _ = emit("ssg", "pseudo-long", radius=2)
+    assert "step direction" in text
+    assert "est. scalar FP ops/pt" in text
+
+
+def test_dot_formats():
+    lite, _ = emit("ssg", "dot-lite", radius=2)
+    assert lite.startswith("digraph")
+    assert '"v_x" -> ' in lite or '"s_xx" -> ' in lite
+    full, _ = emit("3axis", "dot")
+    assert "eq0" in full
+
+
+def test_py_module_round_trip():
+    text, sb = emit("iso3dfd", "py-api", radius=2)
+    ns = {}
+    exec(text, ns)
+    rebuilt = ns["get_solution"]()
+    orig = sb.get_soln()
+    assert rebuilt.get_num_equations() == orig.get_num_equations()
+    assert {v.get_name() for v in rebuilt.get_vars()} == \
+        {v.get_name() for v in orig.get_vars()}
+    # analysis agrees
+    a1, a2 = rebuilt.analyze(), orig.analyze()
+    assert len(a1.stages) == len(a2.stages)
+    assert a1.counters.num_ops == a2.counters.num_ops
+
+
+def test_py_module_round_trip_with_conditions():
+    text, sb = emit("awp_elastic", "py-api")
+    ns = {}
+    exec(text, ns)
+    rebuilt = ns["get_solution"]()
+    conds = [e for e in rebuilt.get_equations() if e.cond is not None]
+    assert conds, "IF_DOMAIN conditions survived the round trip"

@@ -1,0 +1,149 @@
+"""Kernel runtime tests: var API, lifecycle, stats, validation — the analog
+of the reference's kernel API tests (``src/kernel/tests/yask_kernel_api_test
+.py:84-327``: slice get/set via numpy, fixed-size vars, reductions, steps)."""
+
+import numpy as np
+import pytest
+
+from yask_tpu import yk_factory, YaskException
+from yask_tpu.compiler.solution import yc_factory
+
+
+@pytest.fixture(scope="module")
+def env():
+    return yk_factory().new_env()
+
+
+def make_heat(env, g=16, mode=None, **opts):
+    ctx = yk_factory().new_solution(env, stencil="3axis", radius=1)
+    ctx.apply_command_line_options(f"-g {g}")
+    if mode:
+        ctx.get_settings().mode = mode
+    for k, v in opts.items():
+        setattr(ctx.get_settings(), k, v)
+    ctx.prepare_solution()
+    return ctx
+
+
+def test_lifecycle_and_var_geometry(env):
+    ctx = make_heat(env)
+    assert ctx.is_prepared()
+    assert ctx.get_step_dim_name() == "t"
+    assert ctx.get_domain_dim_names() == ["x", "y", "z"]
+    v = ctx.get_var("A")
+    assert v.get_dim_names() == ["t", "x", "y", "z"]
+    assert v.get_halo_size("x") == 1
+    assert v.get_left_pad_size("x") >= 1
+    assert v.get_alloc_size("x") >= 16 + 2
+    assert v.get_alloc_size("t") == 2
+    assert v.is_storage_allocated()
+
+
+def test_element_and_slice_access(env):
+    ctx = make_heat(env)
+    v = ctx.get_var("A")
+    v.set_element(3.5, [0, 5, 6, 7])
+    assert v.get_element([0, 5, 6, 7]) == pytest.approx(3.5)
+    v.add_to_element(1.0, [0, 5, 6, 7])
+    assert v.get_element([0, 5, 6, 7]) == pytest.approx(4.5)
+
+    data = np.arange(4 * 4 * 4, dtype=np.float32).reshape(4, 4, 4)
+    n = v.set_elements_in_slice(data, [0, 2, 2, 2], [0, 5, 5, 5])
+    assert n == 64
+    back = v.get_elements_in_slice([0, 2, 2, 2], [0, 5, 5, 5])
+    np.testing.assert_allclose(back, data)
+
+    assert v.reduce_elements_in_slice(
+        "sum", [0, 2, 2, 2], [0, 5, 5, 5]) == pytest.approx(float(data.sum()))
+    assert v.reduce_elements_in_slice(
+        "max", [0, 2, 2, 2], [0, 5, 5, 5]) == pytest.approx(63.0)
+    with pytest.raises(YaskException):
+        v.reduce_elements_in_slice("bogus", [0, 2, 2, 2], [0, 5, 5, 5])
+
+
+def test_run_and_oracle_match(env):
+    ctx = make_heat(env)
+    ctx.get_var("A").set_elements_in_seq(0.1)
+    ctx.run_solution(0, 4)
+    ref = make_heat(env, mode="ref")
+    ref.get_var("A").set_elements_in_seq(0.1)
+    ref.run_solution(0, 4)
+    assert ctx.compare_data(ref) == 0
+    st = ctx.get_stats()
+    assert st.get_num_steps_done() == 5
+    assert st.get_num_elements() == 16 ** 3
+    assert st.get_elapsed_secs() > 0
+    assert st.get_pts_per_sec() > 0
+    assert "throughput" in st.format()
+
+
+def test_step_indexing_after_run(env):
+    ctx = make_heat(env)
+    ctx.get_var("A").set_all_elements_same(1.0)
+    ctx.run_solution(0, 2)
+    v = ctx.get_var("A")
+    # after 3 steps, steps 2 (older) and 3 (newest) are retained
+    v.get_element([3, 0, 0, 0])
+    v.get_element([2, 0, 0, 0])
+    with pytest.raises(YaskException):
+        v.get_element([0, 0, 0, 0])   # evicted step
+
+
+def test_wf_chunking_equivalence(env):
+    a = make_heat(env)
+    a.get_var("A").set_elements_in_seq(0.1)
+    a.run_solution(0, 5)
+    b = make_heat(env, wf_steps=2)
+    b.get_var("A").set_elements_in_seq(0.1)
+    b.run_solution(0, 5)
+    assert a.compare_data(b) == 0
+
+
+def test_boundary_ghosts_are_zero(env):
+    ctx = make_heat(env, g=8)
+    v = ctx.get_var("A")
+    v.set_all_elements_same(2.0)
+    # pads are excluded from fills: reading just outside the domain gives 0
+    assert v.get_element([0, -1, 0, 0]) == 0.0
+    assert v.get_element([0, 8, 3, 3]) == 0.0
+
+
+def test_hooks(env):
+    calls = []
+    ctx = yk_factory().new_solution(env, stencil="3axis", radius=1)
+    ctx.apply_command_line_options("-g 8")
+    ctx.call_before_prepare_solution(lambda c: calls.append("bp"))
+    ctx.call_after_prepare_solution(lambda c: calls.append("ap"))
+    ctx.call_before_run_solution(lambda c: calls.append("br"))
+    ctx.call_after_run_solution(lambda c: calls.append("ar"))
+    ctx.prepare_solution()
+    ctx.run_solution(0, 0)
+    assert calls == ["bp", "ap", "br", "ar"]
+
+
+def test_cli_help_and_env(env):
+    ctx = yk_factory().new_solution(env, stencil="3axis", radius=1)
+    h = ctx.get_command_line_help()
+    assert "-g <val>" in h and "-mode <val>" in h
+    assert env.get_num_ranks() >= 1
+    env.global_barrier()
+    assert env.sum_over_ranks(3) == 3
+    assert yk_factory().get_version_string()
+
+
+def test_custom_solution_object(env):
+    soln = yc_factory().new_solution("custom")
+    t = soln.new_step_index("t")
+    x = soln.new_domain_index("x")
+    u = soln.new_var("u", [t, x])
+    u(t + 1, x).EQUALS(0.5 * (u(t, x - 1) + u(t, x + 1)))
+    ctx = yk_factory().new_solution(env, soln)
+    ctx.apply_command_line_options("-g 32")
+    ctx.prepare_solution()
+    arr = np.sin(np.arange(32, dtype=np.float32))
+    ctx.get_var("u").set_elements_in_slice(arr, [0, 0], [0, 31])
+    ctx.run_solution(0, 0)
+    got = ctx.get_var("u").get_elements_in_slice([1, 0], [1, 31])
+    pad = np.pad(arr, 1)
+    want = 0.5 * (pad[:-2] + pad[2:])
+    np.testing.assert_allclose(got, want, rtol=1e-5)

@@ -1,0 +1,48 @@
+"""yask_tpu — a TPU-native stencil-computation framework.
+
+A from-scratch re-design of the capabilities of intel/yask for TPU:
+
+* a stencil DSL **compiler** (``yask_tpu.compiler``): equations are built as an
+  AST via operator overloading (the ``yc_*`` API surface of the reference,
+  ``include/yask_compiler_api.hpp``), analyzed for dependencies, partitioned
+  into parts/stages, and **lowered to JAX/XLA and Pallas** instead of
+  intrinsic-laden C++;
+* a kernel **runtime** (``yask_tpu.runtime``): the ``yk_*`` API surface
+  (``include/yask_kernel_api.hpp``) — solutions, vars with halo/pad geometry,
+  stats, auto-tuning — executing as compiled JAX programs;
+* **distribution** (``yask_tpu.parallel``): the reference's MPI rank grid +
+  halo exchange (``src/kernel/lib/setup.cpp``, ``halo.cpp``) becomes an N-D
+  ``jax.sharding.Mesh`` with ``shard_map`` + ``lax.ppermute`` ghost-cell
+  exchange over ICI;
+* a **stencil library** (``yask_tpu.stencils``) covering the reference's
+  ``src/stencils`` solutions (iso3dfd, ssg, fsg, awp, tti, …).
+
+Nothing in this package is a translation of the reference's C++; file:line
+citations in docstrings point at the behavior being matched, not code reused.
+"""
+
+__version__ = "0.1.0"
+
+# Public API surface (mirrors the three reference headers:
+# yask_common_api.hpp, yask_compiler_api.hpp, yask_kernel_api.hpp).
+from yask_tpu.utils.exceptions import YaskException  # noqa: F401
+from yask_tpu.utils.idx_tuple import IdxTuple  # noqa: F401
+from yask_tpu.utils.fd_coeff import (  # noqa: F401
+    get_center_fd_coefficients,
+    get_forward_fd_coefficients,
+    get_backward_fd_coefficients,
+    get_arbitrary_fd_coefficients,
+)
+from yask_tpu.utils.output import yask_output_factory  # noqa: F401
+from yask_tpu.utils.cli import CommandLineParser  # noqa: F401
+
+from yask_tpu.compiler.node_api import yc_node_factory  # noqa: F401
+from yask_tpu.compiler.solution import yc_factory, yc_solution  # noqa: F401
+from yask_tpu.compiler.solution_base import (  # noqa: F401
+    yc_solution_base,
+    yc_solution_with_radius_base,
+    register_solution,
+    get_registered_solutions,
+)
+
+from yask_tpu.runtime.factory import yk_factory  # noqa: F401

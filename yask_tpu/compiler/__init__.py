@@ -1,0 +1,34 @@
+"""Stencil DSL compiler: AST → analysis → TPU lowering.
+
+TPU-native counterpart of the reference's ``src/compiler`` layer: the same
+pipeline (expression AST, equation validity/dependency analysis, partitioning
+into parts and stages, halo calculation) but the code generators emit JAX/XLA
+computations and Pallas kernels instead of intrinsic C++ source text
+(``src/compiler/lib/Solution.cpp:241-259`` picks printers; here
+``yc_solution.output_solution``/``compile`` picks lowering targets).
+"""
+
+from yask_tpu.compiler.expr import (
+    ConstExpr,
+    IndexExpr,
+    IndexType,
+    NumExpr,
+    VarPoint,
+    EqualsExpr,
+)
+from yask_tpu.compiler.var import Var
+from yask_tpu.compiler.solution import yc_solution, yc_factory
+from yask_tpu.compiler.solution_base import (
+    yc_solution_base,
+    yc_solution_with_radius_base,
+    register_solution,
+    get_registered_solutions,
+)
+from yask_tpu.compiler.node_api import yc_node_factory
+
+__all__ = [
+    "ConstExpr", "IndexExpr", "IndexType", "NumExpr", "VarPoint",
+    "EqualsExpr", "Var", "yc_solution", "yc_factory", "yc_solution_base",
+    "yc_solution_with_radius_base", "register_solution",
+    "get_registered_solutions", "yc_node_factory",
+]

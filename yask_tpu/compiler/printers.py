@@ -1,0 +1,273 @@
+"""Debug/text printers for solutions and expressions.
+
+Counterpart of the reference's printer framework and debug formats
+(``src/compiler/lib/Print.cpp``: ``PseudoPrinter``, ``DOTPrinter``;
+selected by target in ``Solution.cpp:241-259``). The ``py-api`` printer is
+the TPU analog of the reference's generated-code output: a self-contained
+Python module that rebuilds the solution through the public DSL API.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from yask_tpu.compiler.expr import (
+    AddExpr,
+    AndExpr,
+    CompExpr,
+    ConstExpr,
+    DivExpr,
+    EqualsExpr,
+    Expr,
+    FirstIndexExpr,
+    FuncExpr,
+    IndexExpr,
+    LastIndexExpr,
+    ModExpr,
+    MultExpr,
+    NegExpr,
+    NotExpr,
+    OrExpr,
+    SubExpr,
+    VarPoint,
+)
+
+
+# ---------------------------------------------------------------------------
+# expression formatting
+# ---------------------------------------------------------------------------
+
+
+def _fmt_const(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def format_expr(e: Expr) -> str:
+    """Render an expression as infix text (the pseudo-printer's expression
+    syntax: ``u(t+1, x, y)``, offsets shown inline)."""
+    if isinstance(e, ConstExpr):
+        return _fmt_const(e.value)
+    if isinstance(e, IndexExpr):
+        return e.name
+    if isinstance(e, FirstIndexExpr):
+        return f"FIRST_INDEX({e.dim.name})"
+    if isinstance(e, LastIndexExpr):
+        return f"LAST_INDEX({e.dim.name})"
+    if isinstance(e, VarPoint):
+        args = []
+        for d in e.var.get_dims():
+            ofs = e.offsets[d.name]
+            if d.type.value == "misc":
+                args.append(str(ofs))
+            elif ofs == 0:
+                args.append(d.name)
+            elif ofs > 0:
+                args.append(f"{d.name}+{ofs}")
+            else:
+                args.append(f"{d.name}{ofs}")
+        return f"{e.var_name()}({', '.join(args)})"
+    if isinstance(e, NegExpr):
+        return f"(-{format_expr(e.arg)})"
+    if isinstance(e, AddExpr):
+        return "(" + " + ".join(format_expr(a) for a in e.args) + ")"
+    if isinstance(e, MultExpr):
+        return "(" + " * ".join(format_expr(a) for a in e.args) + ")"
+    if isinstance(e, SubExpr):
+        return f"({format_expr(e.lhs)} - {format_expr(e.rhs)})"
+    if isinstance(e, DivExpr):
+        return f"({format_expr(e.lhs)} / {format_expr(e.rhs)})"
+    if isinstance(e, ModExpr):
+        return f"({format_expr(e.lhs)} % {format_expr(e.rhs)})"
+    if isinstance(e, FuncExpr):
+        return f"{e.name}({', '.join(format_expr(a) for a in e.args)})"
+    if isinstance(e, CompExpr):
+        return f"({format_expr(e.lhs)} {e.op} {format_expr(e.rhs)})"
+    if isinstance(e, AndExpr):
+        return f"({format_expr(e.lhs)} && {format_expr(e.rhs)})"
+    if isinstance(e, OrExpr):
+        return f"({format_expr(e.lhs)} || {format_expr(e.rhs)})"
+    if isinstance(e, NotExpr):
+        return f"(!{format_expr(e.arg)})"
+    if isinstance(e, EqualsExpr):
+        s = f"{format_expr(e.lhs)} EQUALS {format_expr(e.rhs)}"
+        if e.cond is not None:
+            s += f" IF_DOMAIN {format_expr(e.cond)}"
+        if e.step_cond is not None:
+            s += f" IF_STEP {format_expr(e.step_cond)}"
+        return s
+    return f"<{type(e).__name__}>"
+
+
+# ---------------------------------------------------------------------------
+# pseudo printer
+# ---------------------------------------------------------------------------
+
+
+def print_pseudo(soln, long: bool = False) -> str:
+    """Human-readable solution listing (reference ``PseudoPrinter``; the
+    ``long`` variant additionally expands analysis results per part/stage)."""
+    ana = soln.analyze()
+    out: List[str] = []
+    out.append(f"// Solution '{soln.get_name()}' "
+               f"({soln.get_num_equations()} equation(s)).")
+    out.append(f"// Step dim: {soln.step_dim_name() or '(none)'}; "
+               f"domain dims: {', '.join(soln.domain_dim_names())}.")
+    for v in soln.get_vars():
+        kind = "scratch var" if v.is_scratch() else "var"
+        halo = ", ".join(f"{d}:[-{l},+{r}]" for d, (l, r) in v.halo.items())
+        out.append(f"{kind} {v.get_name()}({', '.join(v.get_dim_names())}); "
+                   f"// halo {halo or 'n/a'}; "
+                   f"step-alloc {v.get_step_alloc_size()}")
+    for i, stage in enumerate(ana.stages):
+        out.append(f"\n//// Stage {i}:")
+        for part in stage.parts:
+            out.append(f"// Part '{part.name}' "
+                       f"({len(part.eqs)} equation(s)):")
+            for eq in part.eqs:
+                out.append(format_expr(eq) + ";")
+    if long:
+        out.append("\n//// Analysis detail:")
+        out.append(f"// step direction: {ana.step_dir:+d}")
+        for part in ana.parts:
+            deps = ", ".join(p.name for p in part.deps) or "(none)"
+            out.append(f"// part '{part.name}' depends on: {deps}")
+        c = ana.counters
+        out.append(f"// est. scalar FP ops/pt: {c.num_ops}; "
+                   f"reads/pt: {c.num_reads}; writes/pt: {c.num_writes}")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# DOT printer
+# ---------------------------------------------------------------------------
+
+
+def print_dot(soln, lite: bool = True) -> str:
+    """Graphviz rendering of equation/var dependencies (reference
+    ``DOTPrinter``). ``lite`` shows var-level edges only; the full form adds
+    one node per equation."""
+    ana = soln.analyze()
+    out = ["digraph \"" + soln.get_name() + "\" {", "  rankdir=LR;"]
+    for v in soln.get_vars():
+        shape = "box" if not v.is_scratch() else "ellipse"
+        out.append(f'  "{v.get_name()}" [shape={shape}];')
+    if lite:
+        seen = set()
+        for eq in soln.get_equations():
+            from yask_tpu.compiler.expr import count_points
+            lhs_var = eq.lhs.var_name()
+            for p in count_points(eq.rhs):
+                edge = (p.var_name(), lhs_var)
+                if edge not in seen:
+                    seen.add(edge)
+                    out.append(f'  "{edge[0]}" -> "{edge[1]}";')
+    else:
+        for i, eq in enumerate(soln.get_equations()):
+            from yask_tpu.compiler.expr import count_points
+            eq_node = f"eq{i}"
+            label = format_expr(eq.lhs)
+            out.append(f'  "{eq_node}" [shape=plaintext, label="{label}"];')
+            out.append(f'  "{eq_node}" -> "{eq.lhs.var_name()}";')
+            for p in count_points(eq.rhs):
+                out.append(f'  "{p.var_name()}" -> "{eq_node}";')
+    out.append("}")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Python-module printer (the TPU "codegen output")
+# ---------------------------------------------------------------------------
+
+
+def _expr_to_py(e: Expr, var_names: Dict[str, str]) -> str:
+    """Emit Python DSL source rebuilding ``e``."""
+    if isinstance(e, ConstExpr):
+        return _fmt_const(e.value)
+    if isinstance(e, IndexExpr):
+        return e.name
+    if isinstance(e, FirstIndexExpr):
+        return f"nfac.new_first_domain_index({e.dim.name})"
+    if isinstance(e, LastIndexExpr):
+        return f"nfac.new_last_domain_index({e.dim.name})"
+    if isinstance(e, VarPoint):
+        args = []
+        for d in e.var.get_dims():
+            ofs = e.offsets[d.name]
+            if d.type.value == "misc":
+                args.append(str(ofs))
+            elif ofs == 0:
+                args.append(d.name)
+            else:
+                args.append(f"{d.name}{ofs:+d}")
+        return f"{var_names[e.var_name()]}({', '.join(args)})"
+    if isinstance(e, NegExpr):
+        return f"(-{_expr_to_py(e.arg, var_names)})"
+    if isinstance(e, AddExpr):
+        return "(" + " + ".join(_expr_to_py(a, var_names) for a in e.args) + ")"
+    if isinstance(e, MultExpr):
+        return "(" + " * ".join(_expr_to_py(a, var_names) for a in e.args) + ")"
+    if isinstance(e, SubExpr):
+        return (f"({_expr_to_py(e.lhs, var_names)} - "
+                f"{_expr_to_py(e.rhs, var_names)})")
+    if isinstance(e, DivExpr):
+        return (f"({_expr_to_py(e.lhs, var_names)} / "
+                f"{_expr_to_py(e.rhs, var_names)})")
+    if isinstance(e, ModExpr):
+        return (f"({_expr_to_py(e.lhs, var_names)} % "
+                f"{_expr_to_py(e.rhs, var_names)})")
+    if isinstance(e, FuncExpr):
+        args = ", ".join(_expr_to_py(a, var_names) for a in e.args)
+        return f"expr.FuncExpr('{e.name}', ({args},))"
+    if isinstance(e, CompExpr):
+        return (f"({_expr_to_py(e.lhs, var_names)} {e.op} "
+                f"{_expr_to_py(e.rhs, var_names)})")
+    if isinstance(e, AndExpr):
+        return (f"({_expr_to_py(e.lhs, var_names)} & "
+                f"{_expr_to_py(e.rhs, var_names)})")
+    if isinstance(e, OrExpr):
+        return (f"({_expr_to_py(e.lhs, var_names)} | "
+                f"{_expr_to_py(e.rhs, var_names)})")
+    if isinstance(e, NotExpr):
+        return f"(~{_expr_to_py(e.arg, var_names)})"
+    raise AssertionError(type(e))
+
+
+def print_py_module(soln) -> str:
+    """Emit a self-contained Python module that rebuilds this solution via
+    the public DSL API and returns it from ``get_solution()`` — the TPU
+    analog of the reference compiler emitting ``yask_stencil_code.hpp``
+    (``YaskKernel.cpp:72-103``): an artifact the kernel runtime consumes."""
+    soln.analyze()
+    lines: List[str] = []
+    a = lines.append
+    a('"""Generated by yask_tpu — rebuilds stencil solution '
+      f"'{soln.get_name()}'.\"\"\"")
+    a("from yask_tpu.compiler import expr")
+    a("from yask_tpu.compiler.solution import yc_factory")
+    a("from yask_tpu.compiler.node_api import yc_node_factory")
+    a("")
+    a("")
+    a("def get_solution():")
+    a(f"    soln = yc_factory().new_solution({soln.get_name()!r})")
+    a("    nfac = yc_node_factory()")
+    idxs = soln.get_indices()
+    for name, idx in idxs.items():
+        a(f"    {name} = soln.new_{idx.type.value}_index({name!r})")
+    var_names: Dict[str, str] = {}
+    for v in soln.get_vars():
+        py = f"v_{v.get_name()}"
+        var_names[v.get_name()] = py
+        dims = ", ".join(d.name for d in v.get_dims())
+        maker = "new_scratch_var" if v.is_scratch() else "new_var"
+        a(f"    {py} = soln.{maker}({v.get_name()!r}, [{dims}])")
+    for eq in soln.get_equations():
+        lhs = _expr_to_py(eq.lhs, var_names)
+        rhs = _expr_to_py(eq.rhs, var_names)
+        cond = _expr_to_py(eq.cond, var_names) if eq.cond is not None else "None"
+        scond = (_expr_to_py(eq.step_cond, var_names)
+                 if eq.step_cond is not None else "None")
+        a(f"    soln.add_eq({lhs}, {rhs}, {cond}, {scond})")
+    a("    return soln")
+    return "\n".join(lines) + "\n"

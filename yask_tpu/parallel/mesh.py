@@ -1,0 +1,49 @@
+"""Device-mesh construction and state shardings.
+
+The reference arranges MPI ranks in an N-D grid and records per-rank offsets
+(``setup_rank``, ``setup.cpp:169``); here the grid is a ``jax.sharding.Mesh``
+whose axis names ARE the solution's domain dims, and per-var shardings are
+``NamedSharding`` partition specs over the dims that are actually split.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from yask_tpu.utils.exceptions import YaskException
+
+
+def build_mesh(env, opts):
+    """Mesh over the device grid implied by ``opts.num_ranks``."""
+    from jax.sharding import Mesh
+    nr = opts.num_ranks
+    dims = nr.get_dim_names()
+    shape = [nr[d] for d in dims]
+    need = int(np.prod(shape))
+    devs = env.get_devices()
+    if need > len(devs):
+        raise YaskException(
+            f"mesh {dict(zip(dims, shape))} needs {need} devices, "
+            f"have {len(devs)}")
+    arr = np.array(devs[:need]).reshape(shape)
+    return Mesh(arr, axis_names=tuple(dims))
+
+
+def state_shardings(mesh, program, opts) -> Dict[str, object]:
+    """Per-var NamedSharding: split each var's domain axes that lie on a
+    mesh axis with extent > 1; everything else replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    out = {}
+    for name, g in program.geoms.items():
+        if g.is_scratch:
+            continue
+        spec = []
+        for n, kind in g.axes:
+            if kind == "domain" and opts.num_ranks.get(n, 1) > 1:
+                spec.append(n)
+            else:
+                spec.append(None)
+        out[name] = NamedSharding(mesh, PartitionSpec(*spec))
+    return out

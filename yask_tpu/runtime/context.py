@@ -1,0 +1,526 @@
+"""The stencil context: ``yk_solution`` driving compiled step programs.
+
+Counterpart of the reference's ``StencilContext``
+(``src/kernel/lib/context.hpp:231-786``, ``context.cpp``, ``soln_apis.cpp``):
+owns settings, vars, and state storage; ``prepare_solution`` performs the
+setup pipeline (decomposition → geometry → allocation, mirroring
+``soln_apis.cpp:137-250``); ``run_solution`` advances steps on the selected
+execution path; ``run_ref``/``compare_data`` implement the validation oracle
+(``context.cpp:46``, ``yask_main.cpp:564-616``).
+
+Execution modes (see ``KernelSettings.mode``):
+
+* ``jit`` — one device: the whole step traced and XLA-fused, steps advanced
+  under ``lax.scan`` with donated (ring-rotated) state.
+* ``sharded`` — global arrays with ``NamedSharding`` over the device mesh;
+  the same traced step; XLA inserts halo collectives for the shifted reads
+  (the idiomatic-TPU replacement for MPI halo exchange).
+* ``shard_map`` — explicit per-shard program with ``lax.ppermute`` ghost
+  exchange (the structural twin of the reference's ``exchange_halos``,
+  ``halo.cpp``), used for overlap control and as the scaling path.
+* ``ref`` — eager numpy oracle (the reference's scalar ``run_ref``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from yask_tpu.utils.exceptions import YaskException
+from yask_tpu.utils.idx_tuple import IdxTuple
+from yask_tpu.utils.timer import YaskTimer
+from yask_tpu.utils.cli import CommandLineParser
+from yask_tpu.runtime.env import yk_env
+from yask_tpu.runtime.settings import KernelSettings
+from yask_tpu.runtime.stats import yk_stats
+from yask_tpu.runtime.var import yk_var
+
+
+class StencilContext:
+    """One runnable instance of a compiled stencil solution."""
+
+    def __init__(self, env: yk_env, source, dtype=None):
+        self._env = env
+        # Accept a yc_solution_base (defines on demand), a yc_solution, or a
+        # pre-lowered CompiledSolution — the flexibility the reference gets
+        # from linking any generated solution into yk_factory.
+        from yask_tpu.compiler.solution import yc_solution
+        from yask_tpu.compiler.solution_base import yc_solution_base
+        from yask_tpu.compiler.lowering import CompiledSolution
+        if isinstance(source, yc_solution_base):
+            if source.get_soln().get_num_equations() == 0:
+                source.define()
+            soln = source.get_soln()
+            self._csol = soln.compile(dtype=dtype)
+        elif isinstance(source, yc_solution):
+            self._csol = source.compile(dtype=dtype)
+        elif isinstance(source, CompiledSolution):
+            self._csol = source
+        else:
+            raise YaskException(
+                f"cannot build a kernel solution from {type(source).__name__}")
+        self._soln = self._csol.soln
+        self._ana = self._csol.ana
+
+        self._opts = KernelSettings(self._ana.domain_dims)
+        self._program = None          # StepProgram (compute geometry)
+        self._state: Optional[Dict[str, List]] = None
+        self._state_on_device = False
+        self._vars: Dict[str, yk_var] = {}
+        self._cur_step = 0
+        self._mode = None
+        self._mesh = None
+        self._shardings = None
+        self._rank_offset: Dict[str, int] = {
+            d: 0 for d in self._ana.domain_dims}
+        self._jit_cache: Dict = {}
+
+        self._run_timer = YaskTimer()
+        self._halo_timer = YaskTimer()
+        self._compile_secs = 0.0
+        self._steps_done = 0
+
+        self._hooks: Dict[str, List[Callable]] = {
+            "before_prepare": [], "after_prepare": [],
+            "before_run": [], "after_run": []}
+
+    # ------------------------------------------------------------------
+    # identity / settings / vars
+    # ------------------------------------------------------------------
+
+    def get_name(self) -> str:
+        return self._soln.get_name()
+
+    def get_description(self) -> str:
+        return self._soln.get_description()
+
+    def get_env(self) -> yk_env:
+        return self._env
+
+    def get_settings(self) -> KernelSettings:
+        return self._opts
+
+    def get_step_dim_name(self) -> str:
+        return self._ana.step_dim or ""
+
+    def get_domain_dim_names(self) -> List[str]:
+        return list(self._ana.domain_dims)
+
+    def set_overall_domain_size(self, dim: str, size: int) -> None:
+        self._opts.global_domain_sizes[dim] = size
+
+    def set_overall_domain_size_vec(self, sizes) -> None:
+        for d, v in (sizes.items() if hasattr(sizes, "items") else sizes):
+            self._opts.global_domain_sizes[d] = v
+
+    def get_overall_domain_size(self, dim: str) -> int:
+        return self._opts.global_domain_sizes[dim]
+
+    def set_rank_domain_size(self, dim: str, size: int) -> None:
+        self._opts.rank_domain_sizes[dim] = size
+
+    def get_rank_domain_size(self, dim: str) -> int:
+        return self._opts.rank_domain_sizes[dim]
+
+    def set_block_size(self, dim: str, size: int) -> None:
+        self._opts.block_sizes[dim] = size
+
+    def get_block_size(self, dim: str) -> int:
+        return self._opts.block_sizes[dim]
+
+    def set_num_ranks(self, dim: str, n: int) -> None:
+        self._opts.num_ranks[dim] = n
+
+    def get_num_ranks(self, dim: str) -> int:
+        return self._opts.num_ranks[dim]
+
+    def get_num_vars(self) -> int:
+        return len([v for v in self._soln.get_vars() if not v.is_scratch()])
+
+    def get_var_names(self) -> List[str]:
+        return [v.get_name() for v in self._soln.get_vars()
+                if not v.is_scratch()]
+
+    def get_var(self, name: str) -> yk_var:
+        if name not in self._vars:
+            raise YaskException(
+                f"no var '{name}' (or prepare_solution not called)")
+        return self._vars[name]
+
+    def get_vars(self) -> List[yk_var]:
+        return list(self._vars.values())
+
+    def first_domain_index(self, dim: str) -> int:
+        return 0
+
+    def last_domain_index(self, dim: str) -> int:
+        return self._opts.global_domain_sizes[dim] - 1
+
+    # ------------------------------------------------------------------
+    # hooks (yk_solution hook registration, soln_apis.cpp)
+    # ------------------------------------------------------------------
+
+    def call_before_prepare_solution(self, fn: Callable) -> None:
+        self._hooks["before_prepare"].append(fn)
+
+    def call_after_prepare_solution(self, fn: Callable) -> None:
+        self._hooks["after_prepare"].append(fn)
+
+    def call_before_run_solution(self, fn: Callable) -> None:
+        self._hooks["before_run"].append(fn)
+
+    def call_after_run_solution(self, fn: Callable) -> None:
+        self._hooks["after_run"].append(fn)
+
+    # ------------------------------------------------------------------
+    # prepare
+    # ------------------------------------------------------------------
+
+    def prepare_solution(self) -> None:
+        """Setup pipeline (reference ``prepare_solution``,
+        ``soln_apis.cpp:137-250``): settings adjustment → decomposition →
+        var geometry → state allocation."""
+        for h in self._hooks["before_prepare"]:
+            h(self)
+        ndev = self._env.get_num_ranks()
+        self._opts.adjust_settings(ndev)
+
+        mode = self._opts.mode
+        nranks = self._opts.num_ranks.product()
+        if mode == "auto":
+            mode = "jit" if nranks == 1 else "sharded"
+        if self._opts.force_scalar:
+            mode = "ref"
+        self._mode = mode
+
+        extra = {d: (self._opts.min_pad_sizes[d], self._opts.min_pad_sizes[d])
+                 for d in self._ana.domain_dims}
+        gsizes = self._opts.global_domain_sizes
+
+        if mode == "shard_map":
+            from yask_tpu.parallel.decomp import validate_shard_geometry
+            validate_shard_geometry(self._csol, self._opts)
+
+        # Compute geometry is always the *global* problem; the shard_map
+        # path re-plans per-shard geometry inside the mapped region.
+        # Sharded mode needs padded extents divisible by the mesh extent
+        # (jax requires whole-dim divisibility for NamedSharding).
+        pad_mult = None
+        if mode == "sharded":
+            pad_mult = {d: self._opts.num_ranks[d]
+                        for d in self._ana.domain_dims
+                        if self._opts.num_ranks[d] > 1}
+        self._plan_kwargs = dict(extra_pad=extra, pad_multiple=pad_mult)
+        self._program = self._csol.plan(gsizes, **self._plan_kwargs)
+        self._state = self._program.alloc_state()
+        self._state_on_device = True
+
+        if mode in ("sharded", "shard_map"):
+            from yask_tpu.parallel.mesh import build_mesh, state_shardings
+            self._mesh = build_mesh(self._env, self._opts)
+            if mode == "sharded":
+                # Resting state lives sharded over the mesh. (shard_map mode
+                # keeps resting state unsharded: its run path shards the
+                # interiors itself with per-shard ghost pads.)
+                self._shardings = state_shardings(
+                    self._mesh, self._program, self._opts)
+                self._apply_shardings()
+
+        self._vars = {v.get_name(): yk_var(self, v.get_name())
+                      for v in self._soln.get_vars() if not v.is_scratch()}
+        self._cur_step = 0
+        self._jit_cache.clear()
+        for h in self._hooks["after_prepare"]:
+            h(self)
+
+    def is_prepared(self) -> bool:
+        return self._program is not None
+
+    def _apply_shardings(self) -> None:
+        import jax
+        for name, ring in self._state.items():
+            sh = self._shardings[name]
+            self._state[name] = [jax.device_put(a, sh) for a in ring]
+
+    # ------------------------------------------------------------------
+    # state plumbing
+    # ------------------------------------------------------------------
+
+    def _check_prepared(self):
+        if self._program is None:
+            raise YaskException("prepare_solution has not been called")
+
+    def _update_state_array(self, name: str, slot: int, fn) -> None:
+        self._check_prepared()
+        arr = self._state[name][slot]
+        new = fn(np.asarray(arr))
+        # Physical-boundary ghost cells are identically zero in every
+        # execution mode (the value unexchanged halos hold in the reference
+        # unless explicitly managed); masking here keeps jit / sharded /
+        # shard_map / ref bit-consistent at domain edges.
+        new = self._zero_pads(name, np.array(new))
+        if self._state_on_device:
+            import jax
+            if self._shardings is not None:
+                new = jax.device_put(new.astype(np.asarray(arr).dtype),
+                                     self._shardings[name])
+            else:
+                new = jax.device_put(new.astype(np.asarray(arr).dtype))
+        self._state[name][slot] = new
+
+    def _zero_pads(self, name: str, arr: np.ndarray) -> np.ndarray:
+        g = self._program.geoms[name]
+        idxs = []
+        for dn, kind in g.axes:
+            if kind == "domain":
+                idxs.append(slice(g.origin[dn],
+                                  g.origin[dn]
+                                  + self._opts.global_domain_sizes[dn]))
+            else:
+                idxs.append(slice(None))
+        out = np.zeros_like(arr)
+        out[tuple(idxs)] = arr[tuple(idxs)]
+        return out
+
+    def _state_to_host(self) -> None:
+        if self._state_on_device:
+            self._state = {k: [np.asarray(a) for a in ring]
+                           for k, ring in self._state.items()}
+            self._state_on_device = False
+
+    def _state_to_device(self) -> None:
+        if not self._state_on_device:
+            import jax
+            out = {}
+            for k, ring in self._state.items():
+                if self._shardings is not None:
+                    out[k] = [jax.device_put(a, self._shardings[k])
+                              for a in ring]
+                else:
+                    out[k] = [jax.device_put(a) for a in ring]
+            self._state = out
+            self._state_on_device = True
+
+    # ------------------------------------------------------------------
+    # run
+    # ------------------------------------------------------------------
+
+    def _step_seq(self, first_t: int, last_t: int):
+        """Evaluation order for the step range (ascending for forward
+        stencils, descending for reverse-time, reference ``run_solution``
+        stride handling)."""
+        if first_t > last_t:
+            first_t, last_t = last_t, first_t
+        n = last_t - first_t + 1
+        start = first_t if self._ana.step_dir > 0 else last_t
+        return start, n
+
+    def run_solution(self, first_step_index: int,
+                     last_step_index: Optional[int] = None) -> None:
+        """Apply the stencil for the given step indices (inclusive), the
+        reference's ``run_solution(first_t, last_t)`` hot path."""
+        self._check_prepared()
+        if last_step_index is None:
+            last_step_index = first_step_index
+        for h in self._hooks["before_run"]:
+            h(self)
+        start, n = self._step_seq(first_step_index, last_step_index)
+
+        if self._opts.do_auto_tune and self._mode in ("jit", "sharded"):
+            from yask_tpu.runtime.auto_tuner import AutoTuner
+            AutoTuner(self).tune_if_needed()
+
+        if self._mode == "ref":
+            self._run_ref_steps(start, n)
+        elif self._mode == "shard_map":
+            from yask_tpu.parallel.shard_step import run_shard_map
+            self._state_to_device()
+            t0 = time.perf_counter()
+            run_shard_map(self, start, n)
+            self._run_timer._elapsed += time.perf_counter() - t0
+        else:
+            self._run_jit_steps(start, n)
+
+        self._cur_step = start + (n - 1) * self._ana.step_dir \
+            + self._ana.step_dir
+        self._steps_done += n
+        for h in self._hooks["after_run"]:
+            h(self)
+
+    def _run_ref_steps(self, start: int, n: int) -> None:
+        from yask_tpu.compiler.lowering import NumpyOps
+        self._state_to_host()
+        prog = self._csol.plan(self._opts.global_domain_sizes,
+                               ops=NumpyOps(), **self._plan_kwargs)
+        with self._run_timer:
+            t = start
+            for _ in range(n):
+                self._state = prog.step(self._state, t)
+                t += self._ana.step_dir
+
+    def _get_compiled_chunk(self, n: int):
+        """Compiled function advancing exactly ``n`` steps (cached per n;
+        the reference caches per-size auto-tuner results the same way)."""
+        key = ("compiled", n)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        import jax
+        from jax import lax
+        prog = self._program
+        dirn = self._ana.step_dir
+
+        def chunk(state, t0):
+            def body(carry, _):
+                st, t = carry
+                st2 = prog.step(st, t)
+                return (st2, t + dirn), None
+            (st, _), _ = lax.scan(body, (state, t0), None, length=n)
+            return st
+
+        self._state_to_device()
+        t0c = time.perf_counter()
+        compiled = jax.jit(chunk, donate_argnums=0) \
+            .lower(self._state, 0).compile()
+        self._compile_secs += time.perf_counter() - t0c
+        self._jit_cache[key] = compiled
+        return compiled
+
+    def _run_jit_steps(self, start: int, n: int) -> None:
+        """Advance ``n`` steps in chunks of ``wf_steps`` (the temporal-
+        tiling analog: one compiled chunk per wf_steps steps, reference
+        wave-front stride over the step loop, ``context.cpp:352``)."""
+        import jax
+        self._state_to_device()
+        wf = self._opts.wf_steps if self._opts.wf_steps > 0 else n
+        dirn = self._ana.step_dir
+        # Pre-compile outside the timed section (the reference excludes
+        # warmup from trials similarly, yask_main.cpp:131).
+        sizes = []
+        rem = n
+        while rem > 0:
+            k = min(wf, rem)
+            sizes.append(k)
+            rem -= k
+        fns = {k: self._get_compiled_chunk(k) for k in set(sizes)}
+        t = start
+        with self._run_timer:
+            st = self._state
+            for k in sizes:
+                st = fns[k](st, t)
+                t += k * dirn
+            jax.block_until_ready(st)
+        self._state = st
+
+    def run_ref(self, first_step_index: int,
+                last_step_index: Optional[int] = None) -> None:
+        """Run the independent eager-numpy oracle over the same state
+        (reference ``run_ref``, ``context.cpp:46``)."""
+        self._check_prepared()
+        if last_step_index is None:
+            last_step_index = first_step_index
+        start, n = self._step_seq(first_step_index, last_step_index)
+        self._run_ref_steps(start, n)
+        self._cur_step = start + n * self._ana.step_dir
+        self._steps_done += n
+
+    # ------------------------------------------------------------------
+    # auto-tuning (yk_solution_api.hpp:839-881)
+    # ------------------------------------------------------------------
+
+    def run_auto_tuner_now(self, candidates=None, min_trial_secs=None) -> int:
+        """Offline auto-tune (advances real steps, like the reference)."""
+        self._check_prepared()
+        from yask_tpu.runtime.auto_tuner import AutoTuner
+        return AutoTuner(self).run_auto_tuner_now(
+            candidates=candidates, min_trial_secs=min_trial_secs)
+
+    def reset_auto_tuner(self, enable: bool = True) -> None:
+        self._tuned = False
+        self._opts.do_auto_tune = enable
+
+    def is_auto_tuner_enabled(self) -> bool:
+        return self._opts.do_auto_tune and not getattr(self, "_tuned", False)
+
+    # ------------------------------------------------------------------
+    # validation (yask_main.cpp:564-616 -validate flow)
+    # ------------------------------------------------------------------
+
+    def compare_data(self, other: "StencilContext", epsilon: float = 1e-4,
+                     abs_epsilon: float = 1e-7) -> int:
+        """Element-wise compare of all common vars against another context;
+        returns #mismatches. Mixed absolute+relative tolerance like the
+        reference's within-tolerance check (``compare_data``): a point
+        mismatches only if |x−y| > abs_eps + eps·max(|x|,|y|), so fp32
+        reassociation noise at near-cancellation points doesn't count."""
+        self._check_prepared()
+        other._check_prepared()
+
+        def interior(ctx, name, arr):
+            g = ctx._program.geoms[name]
+            idxs = []
+            for dn, kind in g.axes:
+                if kind == "domain":
+                    idxs.append(slice(
+                        g.origin[dn],
+                        g.origin[dn] + ctx._opts.global_domain_sizes[dn]))
+                else:
+                    idxs.append(slice(None))
+            return np.asarray(arr, dtype=np.float64)[tuple(idxs)]
+
+        bad = 0
+        for name, ring in self._state.items():
+            if name not in other._state:
+                continue
+            oring = other._state[name]
+            for a, b in zip(ring[::-1], oring[::-1]):
+                x = interior(self, name, a)
+                y = interior(other, name, b)
+                if x.shape != y.shape:
+                    bad += x.size
+                    continue
+                tol = abs_epsilon + epsilon * np.maximum(np.abs(x), np.abs(y))
+                bad += int((np.abs(x - y) > tol).sum())
+        return bad
+
+    # ------------------------------------------------------------------
+    # stats (yk_stats)
+    # ------------------------------------------------------------------
+
+    def get_stats(self) -> yk_stats:
+        c = self._ana.counters
+        npts = self._opts.global_domain_sizes.product()
+        st = yk_stats(
+            npts=npts, nsteps=self._steps_done,
+            nreads_pp=c.num_reads, nwrites_pp=c.num_writes,
+            nfpops_pp=c.num_ops,
+            elapsed=self._run_timer.get_elapsed_secs(),
+            halo_secs=self._halo_timer.get_elapsed_secs(),
+            compile_secs=self._compile_secs)
+        return st
+
+    def clear_stats(self) -> None:
+        self._run_timer.clear()
+        self._halo_timer.clear()
+        self._steps_done = 0
+
+    # ------------------------------------------------------------------
+    # CLI parity
+    # ------------------------------------------------------------------
+
+    def apply_command_line_options(self, args) -> List[str]:
+        if isinstance(args, str):
+            args = args.split()
+        p = CommandLineParser()
+        self._opts.add_options(p)
+        return p.parse_args(list(args))
+
+    def get_command_line_help(self) -> str:
+        p = CommandLineParser()
+        self._opts.add_options(p)
+        return p.print_help()
+
+    def __repr__(self):
+        return (f"<StencilContext '{self.get_name()}' mode={self._mode} "
+                f"prepared={self.is_prepared()}>")

@@ -1,0 +1,90 @@
+"""Kernel environment: device discovery and the mesh bootstrap.
+
+Counterpart of ``yk_env`` / ``KernelEnv`` (reference
+``include/yask_kernel_api.hpp:167-293``, ``src/kernel/lib/settings.hpp:47-80``,
+init in ``setup.cpp:51-90``): where the reference calls
+``MPI_Init_thread`` and splits a shared-memory communicator, the TPU runtime
+discovers JAX devices and exposes them as the "ranks" a solution's domain is
+decomposed over. Collectives over ranks (barriers, reductions, equality
+assertions) are trivial here because the controller is a single process
+driving all devices (JAX SPMD); the API surface is kept for parity.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional
+
+from yask_tpu.utils.exceptions import YaskException
+
+
+class yk_env:
+    """Execution environment: devices, debug output, trace gating."""
+
+    def __init__(self, devices: Optional[List] = None):
+        import jax
+        self._devices = list(devices) if devices is not None else jax.devices()
+        self._trace = False
+        self._debug = sys.stdout
+        self._msg_rank = 0
+
+    # ---- device/"rank" info ---------------------------------------------
+
+    def get_num_ranks(self) -> int:
+        """Number of devices available for domain decomposition (the
+        reference's MPI world size)."""
+        return len(self._devices)
+
+    def get_rank_index(self) -> int:
+        """Always 0: one controller process drives all devices (JAX SPMD);
+        per-device work is expressed via sharding, not per-process code."""
+        return 0
+
+    def get_devices(self) -> List:
+        return list(self._devices)
+
+    def get_platform(self) -> str:
+        return self._devices[0].platform if self._devices else "none"
+
+    # ---- collectives-over-ranks (single-controller no-ops, kept for API
+    # parity with yk_env barriers/reductions) ------------------------------
+
+    def global_barrier(self) -> None:
+        import jax
+        # Materialize any pending async work — the observable effect a
+        # barrier has in the reference harness timing.
+        jax.effects_barrier()
+
+    def sum_over_ranks(self, val: int) -> int:
+        return val
+
+    def min_over_ranks(self, val: int) -> int:
+        return val
+
+    def max_over_ranks(self, val: int) -> int:
+        return val
+
+    def assert_equality_over_ranks(self, val: int, descr: str = "") -> None:
+        return None  # single controller: trivially equal
+
+    # ---- debug & trace ---------------------------------------------------
+
+    def set_trace_enabled(self, enable: bool) -> None:
+        self._trace = bool(enable)
+
+    def is_trace_enabled(self) -> bool:
+        return self._trace
+
+    def set_debug_output(self, out) -> None:
+        self._debug = out.get_ostream() if hasattr(out, "get_ostream") else out
+
+    def get_debug_output(self):
+        return self._debug
+
+    def trace_msg(self, msg: str) -> None:
+        if self._trace:
+            self._debug.write(f"YASK-TPU: {msg}\n")
+
+    def finalize(self) -> None:
+        """Counterpart of MPI_Finalize; nothing to tear down."""

@@ -1,0 +1,161 @@
+"""Runtime settings: every knob the kernel accepts.
+
+Counterpart of ``KernelSettings`` (reference
+``src/kernel/lib/settings.hpp:200-327``, option wiring in ``settings.cpp``):
+domain geometry, tiling sizes, decomposition grid, overlap/exchange toggles,
+and auto-tune controls — re-expressed for TPU execution:
+
+* block sizes become Pallas/XLA tile hints (the auto-tuner's search space);
+* the rank grid becomes the device-mesh shape;
+* ``overlap_comms``/``use_shm``/``use_device_mpi`` collapse into the
+  execution-mode choice (XLA async collectives already overlap; there is no
+  host/device copy distinction on TPU) — they are accepted and recorded so
+  reference command lines keep working.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from yask_tpu.utils.exceptions import YaskException
+from yask_tpu.utils.idx_tuple import IdxTuple
+from yask_tpu.utils.cli import CommandLineParser
+
+
+#: Execution modes for run_solution.
+MODES = ("auto",       # single device → "jit"; >1 rank requested → "sharded"
+         "jit",        # single-device jitted jnp program
+         "sharded",    # global arrays + NamedSharding (XLA inserts comms)
+         "shard_map",  # explicit per-shard program + ppermute halo exchange
+         "ref",        # eager numpy oracle (the reference's run_ref)
+         )
+
+
+class KernelSettings:
+    """All runtime knobs for one solution instance."""
+
+    def __init__(self, domain_dims: List[str]):
+        self.domain_dims = list(domain_dims)
+        z = {d: 0 for d in domain_dims}
+        # Geometry (reference -g / -d / -b … options).
+        self.global_domain_sizes = IdxTuple(z)   # -g* (0 = derive from rank)
+        self.rank_domain_sizes = IdxTuple(z)     # -d* (0 = derive from global)
+        self.block_sizes = IdxTuple(z)           # -b* tile hints (0 = auto)
+        self.min_pad_sizes = IdxTuple(z)         # -mp* extra pad per dim
+        self.num_ranks = IdxTuple(z)             # -nr* mesh grid (0 = auto)
+        # Temporal tiling (reference wave-front options, context.hpp:331).
+        self.wf_steps = 0          # steps fused per compiled chunk (0 = auto)
+        # Behavior toggles.
+        self.mode = "auto"
+        self.overlap_comms = True
+        self.use_shm = True            # accepted for parity; no-op on TPU
+        self.use_device_mpi = True     # accepted for parity; no-op on TPU
+        self.bundle_allocs = True
+        self.force_scalar = False      # run the numpy oracle path
+        # Auto-tuner (reference auto_tuner.hpp options).
+        self.do_auto_tune = False
+        self.auto_tune_each_stage = False
+        self.auto_tune_trial_secs = 0.5
+        # Misc.
+        self.max_threads = 0           # accepted for parity; XLA manages
+        self.numa_pref = -1            # accepted for parity
+        self.allow_addl_pad = True
+
+    # ------------------------------------------------------------------
+
+    def add_options(self, parser: CommandLineParser) -> None:
+        """Register every option (reference ``KernelSettings::add_options``).
+        Option names follow the reference CLI (``-g``, ``-d``, ``-b``,
+        ``-nr``, ``-wf_steps``…), with per-dim forms like ``-d_x``."""
+        dd = self.domain_dims
+        parser.add_idx_option(
+            "g", "Global (overall) domain size in each dim.", self,
+            "global_domain_sizes", dd)
+        parser.add_idx_option(
+            "d", "Per-rank domain size in each dim.", self,
+            "rank_domain_sizes", dd)
+        parser.add_idx_option(
+            "b", "Block (tile) size hint in each dim.", self,
+            "block_sizes", dd)
+        parser.add_idx_option(
+            "mp", "Minimum extra pad in each dim.", self,
+            "min_pad_sizes", dd)
+        parser.add_idx_option(
+            "nr", "Number of ranks (mesh extent) in each dim.", self,
+            "num_ranks", dd)
+        parser.add_int_option(
+            "wf_steps", "Steps fused per compiled chunk (temporal "
+            "wave-front analog).", self, "wf_steps")
+        parser.add_string_option(
+            "mode", f"Execution mode, one of {MODES}.", self, "mode")
+        parser.add_bool_option(
+            "overlap_comms", "Overlap ghost exchange with interior compute.",
+            self, "overlap_comms")
+        parser.add_bool_option(
+            "use_shm", "Accepted for reference parity (no-op on TPU).",
+            self, "use_shm")
+        parser.add_bool_option(
+            "use_device_mpi", "Accepted for reference parity (no-op on TPU).",
+            self, "use_device_mpi")
+        parser.add_bool_option(
+            "force_scalar", "Use the eager numpy oracle instead of the "
+            "compiled path.", self, "force_scalar")
+        parser.add_bool_option(
+            "auto_tune", "Auto-tune tile sizes during the run.", self,
+            "do_auto_tune")
+        parser.add_int_option(
+            "max_threads", "Accepted for reference parity.", self,
+            "max_threads")
+
+    # ------------------------------------------------------------------
+
+    def adjust_settings(self, num_devices: int = 1) -> None:
+        """Derive unset values (reference ``adjust_settings``,
+        ``settings.cpp``): rank grid from device count, global↔rank domain
+        sizes, default block sizes."""
+        if self.mode not in MODES:
+            raise YaskException(f"unknown mode '{self.mode}'; one of {MODES}")
+
+        # Rank grid: like the reference, one rank unless the user asks for
+        # decomposition (mpirun -np there; -nr/-mode here). A total of -1 in
+        # the first dim means "auto": factorize all devices over the grid
+        # keeping the minor-most dim whole for TPU lanes.
+        nr = self.num_ranks
+        if any(v < 0 for v in nr.get_vals()):
+            from yask_tpu.parallel.decomp import factorize_rank_grid
+            auto = factorize_rank_grid(max(num_devices, 1), self.domain_dims)
+            for d in self.domain_dims:
+                nr[d] = auto[d]
+        elif all(v == 0 for v in nr.get_vals()) and num_devices > 1 \
+                and self.mode in ("sharded", "shard_map"):
+            # Distribution requested by mode but no grid given: split the
+            # outer-most dim so halo slabs stay lane-contiguous.
+            for d in self.domain_dims:
+                nr[d] = 1
+            nr[self.domain_dims[0]] = num_devices
+        else:
+            for d in self.domain_dims:
+                if nr[d] == 0:
+                    nr[d] = 1
+        if nr.product() > max(num_devices, 1):
+            raise YaskException(
+                f"rank grid {nr} needs {nr.product()} devices, "
+                f"only {num_devices} available")
+
+        # Domain sizes: global ⇄ rank.
+        for d in self.domain_dims:
+            g, r, n = self.global_domain_sizes[d], self.rank_domain_sizes[d], nr[d]
+            if g == 0 and r == 0:
+                raise YaskException(f"domain size for dim '{d}' not set")
+            if g == 0:
+                self.global_domain_sizes[d] = r * n
+            elif r == 0:
+                if g % n != 0:
+                    raise YaskException(
+                        f"global size {g} in dim '{d}' not divisible by "
+                        f"{n} ranks")
+                self.rank_domain_sizes[d] = g // n
+            elif r * n != g:
+                raise YaskException(
+                    f"inconsistent sizes in dim '{d}': global {g} != "
+                    f"rank {r} × {n} ranks")

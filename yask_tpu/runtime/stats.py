@@ -1,0 +1,72 @@
+"""Run statistics: the ``yk_stats`` API.
+
+Counterpart of the reference's ``Stats``/``yk_stats``
+(``src/kernel/lib/context.hpp:145-198``, printed by ``get_stats``,
+``soln_apis.cpp:349,536-551``): points/reads/writes/FLOP throughput over the
+steps done since the last reset, plus the per-phase timer breakdown the
+reference keeps for halo exchange (``context.hpp:318-328``).
+"""
+
+from __future__ import annotations
+
+
+class yk_stats:
+    def __init__(self, npts: int, nsteps: int, nreads_pp: int,
+                 nwrites_pp: int, nfpops_pp: int, elapsed: float,
+                 halo_secs: float = 0.0, compile_secs: float = 0.0):
+        self._npts = npts
+        self._nsteps = nsteps
+        self._nreads_pp = nreads_pp
+        self._nwrites_pp = nwrites_pp
+        self._nfpops_pp = nfpops_pp
+        self._elapsed = elapsed
+        self._halo = halo_secs
+        self._compile = compile_secs
+
+    def get_num_elements(self) -> int:
+        """Points in the global domain (per step)."""
+        return self._npts
+
+    def get_num_steps_done(self) -> int:
+        return self._nsteps
+
+    def get_num_writes_done(self) -> int:
+        return self._npts * self._nwrites_pp * self._nsteps
+
+    def get_num_reads_done(self) -> int:
+        return self._npts * self._nreads_pp * self._nsteps
+
+    def get_est_fp_ops_done(self) -> int:
+        return self._npts * self._nfpops_pp * self._nsteps
+
+    def get_elapsed_secs(self) -> float:
+        return self._elapsed
+
+    def get_halo_secs(self) -> float:
+        return self._halo
+
+    def get_compile_secs(self) -> float:
+        """TPU-specific: XLA compilation time excluded from throughput
+        (the analog of the reference excluding auto-tuner warmup)."""
+        return self._compile
+
+    # -- derived throughput (the log lines YaskUtils.pm:40-58 scrapes) -----
+
+    def get_pts_per_sec(self) -> float:
+        tot = self._npts * self._nsteps
+        return tot / self._elapsed if self._elapsed > 0 else 0.0
+
+    def get_flops(self) -> float:
+        return (self.get_est_fp_ops_done() / self._elapsed
+                if self._elapsed > 0 else 0.0)
+
+    def format(self) -> str:
+        gpts = self.get_pts_per_sec() / 1e9
+        return (f"num-points-per-step: {self._npts}\n"
+                f"num-steps-done: {self._nsteps}\n"
+                f"elapsed-time (sec): {self._elapsed:.6g}\n"
+                f"throughput (num-points/sec): {self.get_pts_per_sec():.6g}\n"
+                f"throughput (GPts/s): {gpts:.6g}\n"
+                f"throughput (est-FLOPS): {self.get_flops():.6g}\n"
+                f"halo-time (sec): {self._halo:.6g}\n"
+                f"compile-time (sec): {self._compile:.6g}\n")

@@ -1,0 +1,266 @@
+"""Runtime vars: the ``yk_var`` API over ring-buffered padded arrays.
+
+Counterpart of the reference's var storage layer
+(``src/kernel/lib/yk_var.hpp``, ``yk_var_apis.cpp``, ~4.8 kLoC): element and
+slice access with numpy interop (the reference uses SWIG pybuffer maps,
+``src/kernel/swig/yask_kernel_api.i:30-87``), halo/pad/alloc geometry per
+dim, step-index wrapping, dirty tracking, reductions, and fixed-size vars.
+
+Storage itself is a list of padded device arrays (the step ring) owned by the
+:class:`~yask_tpu.runtime.context.StencilContext`; a ``yk_var`` is a view
+binding the var name to that state — the functional-JAX analog of the
+reference's ``YkVarImpl`` holding a pointer into bundled allocations.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from yask_tpu.utils.exceptions import YaskException
+
+
+class yk_var:
+    """View of one var's storage + geometry."""
+
+    def __init__(self, ctx, name: str):
+        self._ctx = ctx
+        self._name = name
+        # Per-step-slot dirty flags for ghost regions (reference dirty
+        # bitsets, yk_var.hpp:564,664): True → neighbors' copies stale.
+        self._dirty = True
+
+    # -- identity & geometry ----------------------------------------------
+
+    def _geom(self):
+        g = self._ctx._program.geoms.get(self._name) if self._ctx._program \
+            else None
+        if g is None:
+            raise YaskException(
+                f"var '{self._name}' not available before prepare_solution")
+        return g
+
+    def get_name(self) -> str:
+        return self._name
+
+    def get_num_dims(self) -> int:
+        return len(self._var().get_dims())
+
+    def get_dim_names(self) -> List[str]:
+        return self._var().get_dim_names()
+
+    def is_dim_used(self, dim: str) -> bool:
+        return dim in self._var().get_dim_names()
+
+    def _var(self):
+        return self._ctx._soln.get_var(self._name)
+
+    def is_fixed_size(self) -> bool:
+        return False
+
+    # halo / pad / alloc geometry per domain dim (yk_var_api.hpp geometry
+    # accessors; values fixed at prepare time like the reference post-alloc)
+    def get_left_halo_size(self, dim: str) -> int:
+        return self._var().halo.get(dim, (0, 0))[0]
+
+    def get_right_halo_size(self, dim: str) -> int:
+        return self._var().halo.get(dim, (0, 0))[1]
+
+    def get_halo_size(self, dim: str) -> int:
+        l, r = self._var().halo.get(dim, (0, 0))
+        return max(l, r)
+
+    def set_halo_size(self, dim: str, size: int) -> None:
+        """Grow the halo before prepare (``yk_var::set_halo_size``)."""
+        if self._ctx._program is not None:
+            raise YaskException("cannot change halo after prepare_solution")
+        self._var().update_halo(dim, size)
+        self._var().update_halo(dim, -size)
+
+    def get_left_pad_size(self, dim: str) -> int:
+        return self._geom().pads.get(dim, (0, 0))[0]
+
+    def get_right_pad_size(self, dim: str) -> int:
+        return self._geom().pads.get(dim, (0, 0))[1]
+
+    def get_alloc_size(self, dim: str) -> int:
+        g = self._geom()
+        if dim in g.domain_dims:
+            return g.shape[g.axis_of(dim)]
+        for n, k in g.axes:
+            if n == dim:
+                return g.shape[g.axis_of(dim)]
+        v = self._var()
+        if v.step_dim() is not None and v.step_dim().name == dim:
+            return g.alloc
+        raise YaskException(f"var '{self._name}' has no dim '{dim}'")
+
+    def get_first_misc_index(self, dim: str) -> int:
+        return self._geom().misc_lo[dim]
+
+    def get_last_misc_index(self, dim: str) -> int:
+        g = self._geom()
+        return g.misc_lo[dim] + g.shape[g.axis_of(dim)] - 1
+
+    # -- storage ----------------------------------------------------------
+
+    def is_storage_allocated(self) -> bool:
+        return (self._ctx._state is not None
+                and self._name in self._ctx._state)
+
+    def _ring(self) -> List:
+        if not self.is_storage_allocated():
+            raise YaskException(
+                f"storage for var '{self._name}' not allocated "
+                "(call prepare_solution)")
+        return self._ctx._state[self._name]
+
+    def _slot_for_step(self, t: Optional[int]) -> int:
+        """Map an absolute step index to a ring slot (the reference's
+        step-index wrapping, ``yk_var.hpp:820-825``)."""
+        ring = self._ring()
+        g = self._geom()
+        if not (g.has_step and g.is_written):
+            return 0
+        cur = self._ctx._cur_step
+        if t is None:
+            return len(ring) - 1
+        d = (cur - t) * self._ctx._csol.ana.step_dir
+        slot = len(ring) - 1 - d
+        if not (0 <= slot < len(ring)):
+            raise YaskException(
+                f"step {t} of var '{self._name}' not in allocation "
+                f"(current step {cur}, {len(ring)} slot(s))")
+        return slot
+
+    def _split_indices(self, indices: Sequence[int]) -> Tuple[Optional[int], List]:
+        """Split full-index list (declared dim order) into (step, rest)."""
+        v = self._var()
+        dims = v.get_dims()
+        if len(indices) != len(dims):
+            raise YaskException(
+                f"var '{self._name}' needs {len(dims)} indices, "
+                f"got {len(indices)}")
+        t = None
+        rest = []
+        g = self._geom()
+        for d, i in zip(dims, indices):
+            if d.type.value == "step":
+                t = int(i)
+            elif d.type.value == "domain":
+                rest.append(int(i) + g.origin[d.name]
+                            - self._ctx._rank_offset.get(d.name, 0))
+            else:
+                rest.append(int(i) - g.misc_lo[d.name])
+        return t, rest
+
+    # -- element access (yk_var_api.hpp:700-951) ---------------------------
+
+    def get_element(self, indices: Sequence[int]) -> float:
+        t, rest = self._split_indices(indices)
+        arr = np.asarray(self._ring()[self._slot_for_step(t)])
+        return float(arr[tuple(rest)])
+
+    def set_element(self, val: float, indices: Sequence[int],
+                    strict_indices: bool = True) -> int:
+        t, rest = self._split_indices(indices)
+        slot = self._slot_for_step(t)
+        self._ctx._update_state_array(
+            self._name, slot, lambda a: _np_set(a, tuple(rest), val))
+        self._dirty = True
+        return 1
+
+    def add_to_element(self, val: float, indices: Sequence[int]) -> int:
+        t, rest = self._split_indices(indices)
+        slot = self._slot_for_step(t)
+        self._ctx._update_state_array(
+            self._name, slot,
+            lambda a: _np_set(a, tuple(rest), a[tuple(rest)] + val))
+        self._dirty = True
+        return 1
+
+    # -- slice access ------------------------------------------------------
+
+    def _slice_idx(self, first: Sequence[int], last: Sequence[int]):
+        tf, rf = self._split_indices(first)
+        tl, rl = self._split_indices(last)
+        if tf is not None and tl is not None and tf != tl:
+            raise YaskException("slice access must use a single step index")
+        idx = tuple(slice(a, b + 1) for a, b in zip(rf, rl))
+        return tf, idx
+
+    def get_elements_in_slice(self, first_indices: Sequence[int],
+                              last_indices: Sequence[int]) -> np.ndarray:
+        """Return a numpy copy of the box [first, last] (inclusive), the
+        buffer-protocol surface the reference exposes via SWIG pybuffer."""
+        t, idx = self._slice_idx(first_indices, last_indices)
+        arr = np.asarray(self._ring()[self._slot_for_step(t)])
+        return np.array(arr[idx])
+
+    def set_elements_in_slice(self, buf, first_indices: Sequence[int],
+                              last_indices: Sequence[int]) -> int:
+        t, idx = self._slice_idx(first_indices, last_indices)
+        slot = self._slot_for_step(t)
+        data = np.asarray(buf)
+
+        def upd(a):
+            out = np.array(a)
+            out[idx] = data.reshape(out[idx].shape)
+            return out
+        self._ctx._update_state_array(self._name, slot, upd)
+        self._dirty = True
+        return int(np.prod(data.shape)) if data.shape else 1
+
+    def set_all_elements_same(self, val: float) -> None:
+        for slot in range(len(self._ring())):
+            self._ctx._update_state_array(
+                self._name, slot, lambda a: np.full_like(np.asarray(a), val))
+        self._dirty = True
+
+    def set_elements_in_seq(self, seed: float = 0.1) -> None:
+        """Fill with a deterministic position-dependent sequence (the
+        harness' ``-init_seed`` pattern for validation runs,
+        ``yask_main.cpp:239-249``)."""
+        for slot in range(len(self._ring())):
+            def fill(a, s=slot):
+                a = np.asarray(a)
+                n = a.size
+                vals = (np.arange(n, dtype=np.float64) % 17 + 1.0) \
+                    * seed * (s + 1)
+                return vals.reshape(a.shape).astype(a.dtype)
+            self._ctx._update_state_array(self._name, slot, fill)
+        self._dirty = True
+
+    # -- reductions (yk_var_api.hpp:992-1044) ------------------------------
+
+    def reduce_elements_in_slice(self, op: str, first_indices, last_indices) -> float:
+        data = self.get_elements_in_slice(first_indices, last_indices)
+        data64 = data.astype(np.float64)
+        if op in ("sum", "add"):
+            return float(data64.sum())
+        if op in ("product", "mul"):
+            return float(data64.prod())
+        if op == "min":
+            return float(data64.min())
+        if op == "max":
+            return float(data64.max())
+        raise YaskException(f"unknown reduction '{op}'")
+
+    def sum_elements_in_slice(self, first_indices, last_indices) -> float:
+        return self.reduce_elements_in_slice("sum", first_indices, last_indices)
+
+    # -- misc --------------------------------------------------------------
+
+    def format_indices(self, indices: Sequence[int]) -> str:
+        dims = self.get_dim_names()
+        return ", ".join(f"{d}={i}" for d, i in zip(dims, indices))
+
+    def __repr__(self):
+        return f"<yk_var '{self._name}'>"
+
+
+def _np_set(a, idx, val):
+    out = np.array(a)
+    out[idx] = val
+    return out

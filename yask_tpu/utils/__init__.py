@@ -1,0 +1,18 @@
+"""Common substrate shared by the compiler and the kernel runtime.
+
+TPU-native counterpart of the reference's ``src/common`` layer
+(``tuple.hpp``, ``common_utils.cpp``, ``output.cpp``, ``fd_coeff2.cpp``) and
+the shared pieces of ``include/yask_common_api.hpp``.
+"""
+
+from yask_tpu.utils.exceptions import YaskException
+from yask_tpu.utils.idx_tuple import IdxTuple
+from yask_tpu.utils.cli import CommandLineParser
+from yask_tpu.utils.output import yask_output_factory
+
+__all__ = [
+    "YaskException",
+    "IdxTuple",
+    "CommandLineParser",
+    "yask_output_factory",
+]

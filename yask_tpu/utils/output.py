@@ -1,0 +1,84 @@
+"""Pluggable output objects for codegen/debug text.
+
+Counterpart of ``yask_output_factory`` and the four ``yask_output`` kinds in
+the reference (``include/yask_common_api.hpp:184-272``, ``src/common/output.cpp``):
+file, string, stdout, and null sinks, used for printer/debug output routing.
+"""
+
+from __future__ import annotations
+
+import io
+import sys
+from typing import Optional
+
+
+class yask_output:
+    """Base output sink with a file-like ``write``."""
+
+    def get_ostream(self):
+        raise NotImplementedError
+
+    def write(self, text: str) -> None:
+        self.get_ostream().write(text)
+
+
+class yask_file_output(yask_output):
+    def __init__(self, path: str):
+        self._path = path
+        self._f = open(path, "w")
+
+    def get_filename(self) -> str:
+        return self._path
+
+    def get_ostream(self):
+        return self._f
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class yask_string_output(yask_output):
+    def __init__(self):
+        self._buf = io.StringIO()
+
+    def get_ostream(self):
+        return self._buf
+
+    def get_string(self) -> str:
+        return self._buf.getvalue()
+
+    def discard(self) -> None:
+        self._buf = io.StringIO()
+
+
+class yask_stdout_output(yask_output):
+    def get_ostream(self):
+        return sys.stdout
+
+
+class yask_null_output(yask_output):
+    class _Null(io.TextIOBase):
+        def write(self, s):  # noqa: D102
+            return len(s)
+
+    def __init__(self):
+        self._null = self._Null()
+
+    def get_ostream(self):
+        return self._null
+
+
+class yask_output_factory:
+    """Factory mirroring ``yask_output_factory`` in the reference API."""
+
+    def new_file_output(self, path: str) -> yask_file_output:
+        return yask_file_output(path)
+
+    def new_string_output(self) -> yask_string_output:
+        return yask_string_output()
+
+    def new_stdout_output(self) -> yask_stdout_output:
+        return yask_stdout_output()
+
+    def new_null_output(self) -> yask_null_output:
+        return yask_null_output()
