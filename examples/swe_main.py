@@ -1,0 +1,85 @@
+"""Shallow-water-equation mini-app.
+
+Counterpart of the reference's ``src/examples/swe_main.cpp`` (654 LoC):
+drives the kernel API end-to-end — env → solution → domain sizes → prepare →
+init vars (dam-break column) → step loop → slice extraction — and
+self-checks conservation, like the example-tests target.
+
+Run: ``python examples/swe_main.py [-g N] [-steps N] [-plot]``
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from yask_tpu import yk_factory
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    g, steps, plot = 64, 50, False
+    it = iter(range(len(argv)))
+    i = 0
+    while i < len(argv):
+        if argv[i] == "-g":
+            g = int(argv[i + 1]); i += 2
+        elif argv[i] == "-steps":
+            steps = int(argv[i + 1]); i += 2
+        elif argv[i] == "-plot":
+            plot = True; i += 1
+        else:
+            print(f"unknown arg {argv[i]}"); return 2
+
+    fac = yk_factory()
+    env = fac.new_env()
+    ctx = fac.new_solution(env, stencil="swe2d")
+    ctx.apply_command_line_options(f"-g {g}")
+    ctx.prepare_solution()
+
+    # Dam break: a raised column of water in a calm pool.
+    h0 = np.ones((g, g), dtype=np.float32)
+    cx = g // 2
+    r = g // 8
+    yy, xx = np.mgrid[0:g, 0:g]
+    h0[(xx - cx) ** 2 + (yy - cx) ** 2 < r * r] = 2.0
+    ctx.get_var("h").set_elements_in_slice(h0, [0, 0, 0], [0, g-1, g-1])
+    ctx.get_var("hu").set_all_elements_same(0.0)
+    ctx.get_var("hv").set_all_elements_same(0.0)
+    # dt/dx chosen for CFL stability with c = sqrt(g·h) ≈ sqrt(2·2)
+    ctx.get_var("lam").set_element(0.2, [])
+    ctx.get_var("grav").set_element(1.0, [])
+
+    mass0 = float(h0.sum())
+    ctx.run_solution(0, steps - 1)
+    h = ctx.get_var("h").get_elements_in_slice(
+        [steps, 0, 0], [steps, g - 1, g - 1])
+
+    # Self-checks (the reference example-tests style): finite field and
+    # near-conserved interior mass (LxF loses a little at open borders).
+    assert np.isfinite(h).all(), "field went non-finite"
+    mass = float(h.sum())
+    drift = abs(mass - mass0) / mass0
+    print(f"swe2d: {steps} steps on {g}x{g}; mass drift {drift:.3%}; "
+          f"h in [{h.min():.3f}, {h.max():.3f}]")
+    assert drift < 0.2, "mass drifted implausibly"
+    assert h.std() > 1e-3, "wave did not propagate"
+
+    if plot:
+        # crude ASCII contour
+        q = np.linspace(h.min(), h.max(), 5)
+        chars = " .:*#"
+        for row in h[:: max(g // 32, 1)]:
+            print("".join(
+                chars[int(np.searchsorted(q, v, side="right")) - 1]
+                for v in row[:: max(g // 64, 1)]))
+    print("swe2d example: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

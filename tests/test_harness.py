@@ -1,0 +1,82 @@
+"""Harness CLI + tools tests (the analog of the reference's api-tests for
+yask_main and the log-scraper)."""
+
+import io
+import os
+import subprocess
+import sys
+
+import pytest
+
+from yask_tpu.main import run_harness
+from yask_tpu.tools.log_to_csv import scrape
+
+
+def run_cli(args):
+    out = io.StringIO()
+    rc = run_harness(args, out=out)
+    return rc, out.getvalue()
+
+
+def test_list():
+    rc, text = run_cli(["-list"])
+    assert rc == 0
+    assert "iso3dfd" in text and "ssg" in text
+
+
+def test_missing_stencil_is_error():
+    rc, text = run_cli([])
+    assert rc == 2
+    assert "-stencil" in text
+
+
+def test_unknown_option_is_error():
+    from yask_tpu.utils.exceptions import YaskException
+    with pytest.raises(YaskException):
+        run_cli(["-stencil", "3axis", "-g", "8", "-bogus", "1"])
+
+
+def test_perf_flow_log_keys():
+    rc, text = run_cli(["-stencil", "3axis", "-g", "12",
+                        "-trial_steps", "2", "-num_trials", "2"])
+    assert rc == 0
+    assert "mid-throughput (num-points/sec):" in text
+    assert "best-throughput (num-points/sec):" in text
+    # the log scraper reads its own harness output
+    row = scrape(text)
+    assert float(row["mid-throughput (num-points/sec)"]) > 0
+    assert "elapsed-time (sec)" in row
+
+
+def test_validate_flow():
+    rc, text = run_cli(["-stencil", "test_scratch_1d", "-g", "16",
+                        "-validate"])
+    assert rc == 0
+    assert "validation passed" in text
+
+
+def test_validate_multi_stage():
+    rc, text = run_cli(["-stencil", "test_stages_2d", "-g", "12",
+                        "-validate"])
+    assert rc == 0, text
+    assert "validation passed" in text
+
+
+def test_help():
+    rc, text = run_cli(["-help"])
+    assert rc == 0
+    assert "-validate" in text
+
+
+def test_examples_run():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for script, args in (("examples/swe_main.py", ["-g", "24", "-steps", "8"]),
+                         ("examples/wave_eq_main.py",
+                          ["-g", "24", "-steps", "8"])):
+        p = subprocess.run([sys.executable, os.path.join(root, script)]
+                           + args, capture_output=True, text=True, env=env,
+                           timeout=300)
+        assert p.returncode == 0, p.stderr[-800:]
+        assert "PASS" in p.stdout

@@ -11,3 +11,7 @@ from yask_tpu.stencils import simple  # noqa: F401
 from yask_tpu.stencils import iso3dfd  # noqa: F401
 from yask_tpu.stencils import elastic  # noqa: F401
 from yask_tpu.stencils import awp  # noqa: F401
+from yask_tpu.stencils import tti  # noqa: F401
+from yask_tpu.stencils import physics2d  # noqa: F401
+from yask_tpu.stencils import filters  # noqa: F401
+from yask_tpu.stencils import test_stencils  # noqa: F401

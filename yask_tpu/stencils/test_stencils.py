@@ -1,0 +1,239 @@
+"""Feature-coverage test stencils.
+
+Counterpart of the reference's ``src/stencils/TestStencils.cpp:200-1035``:
+one small solution per DSL feature, used as the primary correctness
+fixtures (dimensionality 1-D…4-D, misc dims, scratch chains, multi-stage
+dependencies, sub-domain boundaries, step conditions, reverse time,
+memory-bound streams, math functions).
+"""
+
+from __future__ import annotations
+
+from yask_tpu.compiler.solution_base import (
+    register_solution,
+    yc_solution_base,
+    yc_solution_with_radius_base,
+)
+
+
+class _NdTest(yc_solution_with_radius_base):
+    DIMS = ("x",)
+
+    def define(self):
+        t = self.new_step_index("t")
+        idxs = [self.new_domain_index(d) for d in self.DIMS]
+        u = self.new_var("u", [t] + idxs)
+        r = self.get_radius()
+        expr = u(t, *idxs)
+        for ax in range(len(idxs)):
+            for i in range(1, r + 1):
+                lo = list(idxs)
+                hi = list(idxs)
+                lo[ax] = idxs[ax] - i
+                hi[ax] = idxs[ax] + i
+                expr = expr + u(t, *lo) + u(t, *hi)
+        n = float(1 + 2 * r * len(idxs))
+        u(t + 1, *idxs).EQUALS(expr / n)
+
+
+@register_solution
+class Test1d(_NdTest):
+    DIMS = ("x",)
+
+    def __init__(self):
+        super().__init__("test_1d", radius=1)
+
+
+@register_solution
+class Test2d(_NdTest):
+    DIMS = ("x", "y")
+
+    def __init__(self):
+        super().__init__("test_2d", radius=1)
+
+
+@register_solution
+class Test3d(_NdTest):
+    DIMS = ("x", "y", "z")
+
+    def __init__(self):
+        super().__init__("test_3d", radius=1)
+
+
+@register_solution
+class Test4d(_NdTest):
+    DIMS = ("w", "x", "y", "z")
+
+    def __init__(self):
+        super().__init__("test_4d", radius=1)
+
+
+@register_solution
+class TestMisc2d(yc_solution_base):
+    """Misc dims with negative first index (reference test_misc_2d)."""
+
+    def __init__(self):
+        super().__init__("test_misc_2d")
+
+    def define(self):
+        t = self.new_step_index("t")
+        x = self.new_domain_index("x")
+        y = self.new_domain_index("y")
+        m = self.new_misc_index("m")
+        u = self.new_var("u", [t, x, y])
+        k = self.new_var("k", [m, x, y])
+        u(t + 1, x, y).EQUALS(
+            k(-1, x, y) * u(t, x - 1, y)
+            + k(0, x, y) * u(t, x, y)
+            + k(1, x, y) * u(t, x + 1, y))
+
+
+@register_solution
+class TestScratch1d(yc_solution_base):
+    """Two-level scratch chain (reference test_scratch_* family)."""
+
+    def __init__(self):
+        super().__init__("test_scratch_1d")
+
+    def define(self):
+        t = self.new_step_index("t")
+        x = self.new_domain_index("x")
+        u = self.new_var("u", [t, x])
+        s1 = self.new_scratch_var("s1", [x])
+        s2 = self.new_scratch_var("s2", [x])
+        s1(x).EQUALS(u(t, x - 1) + u(t, x + 1))
+        s2(x).EQUALS(s1(x - 1) * 0.5 + s1(x + 1) * 0.5)
+        u(t + 1, x).EQUALS(u(t, x) + 0.1 * s2(x))
+
+
+@register_solution
+class TestStages2d(yc_solution_base):
+    """Same-step dependency chain → multiple stages (test_stages_*)."""
+
+    def __init__(self):
+        super().__init__("test_stages_2d")
+
+    def define(self):
+        t = self.new_step_index("t")
+        x = self.new_domain_index("x")
+        y = self.new_domain_index("y")
+        a = self.new_var("a", [t, x, y])
+        b = self.new_var("b", [t, x, y])
+        a(t + 1, x, y).EQUALS(
+            0.25 * (a(t, x - 1, y) + a(t, x + 1, y)
+                    + b(t, x, y - 1) + b(t, x, y + 1)))
+        b(t + 1, x, y).EQUALS(b(t, x, y) + 0.5 * a(t + 1, x - 1, y))
+
+
+@register_solution
+class TestBoundary1d(yc_solution_base):
+    """Sub-domain conditions with first/last_domain_index
+    (test_boundary_*)."""
+
+    def __init__(self):
+        super().__init__("test_boundary_1d")
+
+    def define(self):
+        t = self.new_step_index("t")
+        x = self.new_domain_index("x")
+        u = self.new_var("u", [t, x])
+        first = self.first_domain_index(x)
+        last = self.last_domain_index(x)
+        interior = (x > first + 0) & (x < last - 0)
+        u(t + 1, x).EQUALS(
+            0.5 * (u(t, x - 1) + u(t, x + 1))).IF_DOMAIN(
+                (x > first) & (x < last))
+        u(t + 1, x).EQUALS(0.0).IF_DOMAIN((x == first) | (x == last))
+
+
+@register_solution
+class TestStepCond1d(yc_solution_base):
+    """Step conditions: different update on even/odd steps
+    (test_step_cond_1d)."""
+
+    def __init__(self):
+        super().__init__("test_step_cond_1d")
+
+    def define(self):
+        from yask_tpu.compiler.expr import IndexExpr, IndexType
+        t = self.new_step_index("t")
+        x = self.new_domain_index("x")
+        u = self.new_var("u", [t, x])
+        even = (t % 2) == 0
+        odd = (t % 2) == 1
+        u(t + 1, x).EQUALS(u(t, x) + 1.0).IF_STEP(even)
+        u(t + 1, x).EQUALS(u(t, x) * 2.0).IF_STEP(odd)
+
+
+@register_solution
+class TestReverse2d(yc_solution_base):
+    """Reverse-time stepping (test_reverse_2d): writes t-1 from t."""
+
+    def __init__(self):
+        super().__init__("test_reverse_2d")
+
+    def define(self):
+        t = self.new_step_index("t")
+        x = self.new_domain_index("x")
+        y = self.new_domain_index("y")
+        u = self.new_var("u", [t, x, y])
+        u(t - 1, x, y).EQUALS(
+            (u(t, x, y) + u(t, x - 1, y) + u(t, x, y + 1)) / 3.0)
+
+
+@register_solution
+class TestStream3d(yc_solution_base):
+    """Memory-bound stream: many vars, trivial compute (test_stream_*)."""
+
+    def __init__(self):
+        super().__init__("test_stream_3d")
+
+    def define(self):
+        t = self.new_step_index("t")
+        x = self.new_domain_index("x")
+        y = self.new_domain_index("y")
+        z = self.new_domain_index("z")
+        vs = [self.new_var(f"v{i}", [t, x, y, z]) for i in range(4)]
+        for i, v in enumerate(vs):
+            src = vs[(i + 1) % len(vs)]
+            v(t + 1, x, y, z).EQUALS(
+                0.5 * v(t, x, y, z) + 0.5 * src(t, x, y, z))
+
+
+@register_solution
+class TestFunc1d(yc_solution_base):
+    """Math-function nodes (test_func_1d)."""
+
+    def __init__(self):
+        super().__init__("test_func_1d")
+
+    def define(self):
+        from yask_tpu.compiler.expr import sqrt, fabs, exp, sin, cos, max_fn
+        t = self.new_step_index("t")
+        x = self.new_domain_index("x")
+        u = self.new_var("u", [t, x])
+        u(t + 1, x).EQUALS(
+            0.5 * sin(u(t, x)) * cos(u(t, x))
+            + 0.1 * sqrt(fabs(u(t, x - 1)))
+            + 0.01 * exp(-fabs(u(t, x + 1)))
+            + max_fn(u(t, x), 0.0) * 0.01)
+
+
+@register_solution
+class TestPartial3d(yc_solution_base):
+    """Vars spanning subsets of the domain dims, in different orders
+    (test_partial_3d): exercises axis alignment in lowering."""
+
+    def __init__(self):
+        super().__init__("test_partial_3d")
+
+    def define(self):
+        t = self.new_step_index("t")
+        x = self.new_domain_index("x")
+        y = self.new_domain_index("y")
+        z = self.new_domain_index("z")
+        u = self.new_var("u", [t, x, y, z])
+        cx = self.new_var("cx", [x])
+        cyz = self.new_var("cyz", [z, y])   # reversed declaration order
+        u(t + 1, x, y, z).EQUALS(
+            u(t, x, y, z) * cx(x) + u(t, x - 1, y, z) * cyz(z, y))

@@ -1,0 +1,7 @@
+"""Operational tooling: log scraping, launching, trace analysis.
+
+Counterpart of the reference's ``utils/bin`` Perl tooling (SURVEY §2.4):
+``yask_log_to_csv.pl``/``YaskUtils.pm`` → :mod:`yask_tpu.tools.log_to_csv`;
+``yask.sh`` launcher → :mod:`yask_tpu.tools.launch`;
+``analyze_trace.pl`` → :mod:`yask_tpu.tools.analyze_trace`.
+"""

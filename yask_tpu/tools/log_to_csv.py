@@ -1,0 +1,78 @@
+"""Scrape harness logs into CSV.
+
+Counterpart of ``utils/bin/yask_log_to_csv.pl`` + ``utils/lib/YaskUtils.pm``
+(reference :33-58): extract the named metrics from one or more run logs into
+a CSV for performance tracking, throughput keys first (the reference ranks
+"mid" throughput as the primary fitness key).
+
+Usage::
+
+    python -m yask_tpu.tools.log_to_csv run1.log run2.log > perf.csv
+"""
+
+from __future__ import annotations
+
+import csv
+import re
+import sys
+from typing import Dict, List
+
+#: Metric keys in priority order (mirrors YaskUtils.pm:40-58 ordering:
+#: mid/best throughput first).
+KEYS = [
+    "mid-throughput (num-points/sec)",
+    "best-throughput (num-points/sec)",
+    "min-throughput (num-points/sec)",
+    "ave-throughput (num-points/sec)",
+    "stddev-throughput (num-points/sec)",
+    "mid-throughput (GPts/s)",
+    "throughput (num-points/sec)",
+    "throughput (est-FLOPS)",
+    "num-steps-done",
+    "elapsed-time (sec)",
+    "halo-time (sec)",
+    "compile-time (sec)",
+    "num-points-per-step",
+    "domain",
+]
+
+_LINE = re.compile(r"^\s*([\w\- ()/]+?):\s*(.+?)\s*$")
+
+
+def scrape(text: str) -> Dict[str, str]:
+    """Pull the last value for each known key out of a log."""
+    out: Dict[str, str] = {}
+    for line in text.splitlines():
+        m = _LINE.match(line)
+        if not m:
+            continue
+        key, val = m.group(1).strip(), m.group(2)
+        if key in KEYS:
+            out[key] = val
+    return out
+
+
+def logs_to_csv(paths: List[str], out=None) -> None:
+    out = out or sys.stdout
+    rows = []
+    for path in paths:
+        with open(path) as f:
+            row = scrape(f.read())
+        row["log"] = path
+        rows.append(row)
+    cols = ["log"] + [k for k in KEYS if any(k in r for r in rows)]
+    w = csv.DictWriter(out, fieldnames=cols, extrasaction="ignore")
+    w.writeheader()
+    for r in rows:
+        w.writerow(r)
+
+
+def main() -> None:  # pragma: no cover - thin wrapper
+    if len(sys.argv) < 2:
+        sys.stderr.write("usage: log_to_csv <log> [log...]\n")
+        sys.exit(2)
+    logs_to_csv(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()
