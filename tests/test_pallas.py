@@ -1,0 +1,91 @@
+"""Pallas fused-path tests (interpret mode on CPU): the hand-tiled kernel
+with K-step temporal fusion must agree exactly with the XLA path — the
+analog of the reference validating its vector-folded/wave-front kernels
+against the scalar reference across block-size arg-sets (Makefile
+test_args0-4)."""
+
+import numpy as np
+import pytest
+
+from yask_tpu import yk_factory, YaskException
+from yask_tpu.compiler.solution_base import create_solution
+from yask_tpu.ops.pallas_stencil import pallas_applicable
+
+
+@pytest.fixture(scope="module")
+def env():
+    return yk_factory().new_env()
+
+
+def make(env, mode, name="3axis", r=1, g=16, wf=1, block=None):
+    ctx = yk_factory().new_solution(env, stencil=name, radius=r)
+    ctx.apply_command_line_options(f"-g {g}")
+    ctx.get_settings().mode = mode
+    ctx.get_settings().wf_steps = wf
+    if block:
+        for d, b in block.items():
+            ctx.set_block_size(d, b)
+    ctx.prepare_solution()
+    rng = np.random.RandomState(3)
+    for vn in ctx.get_var_names():
+        v = ctx.get_var(vn)
+        if vn == "vel":
+            v.set_all_elements_same(0.05)
+        else:
+            arr = rng.rand(g, g, g).astype(np.float32)
+            v.set_elements_in_slice(arr, [0, 0, 0, 0],
+                                    [0, g - 1, g - 1, g - 1])
+    return ctx
+
+
+@pytest.mark.parametrize("wf", [1, 2, 3, 4])
+def test_pallas_matches_jit_3axis(env, wf):
+    ref = make(env, "jit")
+    ref.run_solution(0, 5)
+    p = make(env, "pallas", wf=wf)
+    p.run_solution(0, 5)   # wf=4 exercises the remainder path (4+2)
+    assert p.compare_data(ref) == 0
+
+
+def test_pallas_iso3dfd_two_slot_ring(env):
+    ref = make(env, "jit", name="iso3dfd", r=2)
+    ref.run_solution(0, 3)
+    p = make(env, "pallas", name="iso3dfd", r=2, wf=2)
+    p.run_solution(0, 3)
+    assert p.compare_data(ref) == 0
+
+
+def test_pallas_diagonal_reads(env):
+    ref = make(env, "jit", name="cube", r=1)
+    ref.run_solution(0, 2)
+    p = make(env, "pallas", name="cube", r=1, wf=1)
+    p.run_solution(0, 2)
+    assert p.compare_data(ref) == 0
+
+
+def test_pallas_block_sizes(env):
+    ref = make(env, "jit")
+    ref.run_solution(0, 3)
+    p = make(env, "pallas", wf=2, block={"x": 4, "y": 16})
+    p.run_solution(0, 3)
+    assert p.compare_data(ref) == 0
+
+
+def test_pallas_applicability_rules():
+    assert pallas_applicable(
+        create_solution("3axis", radius=1).get_soln().compile())[0]
+    # multi-stage (ssg) and condition-bearing (awp) solutions fall back
+    ok, why = pallas_applicable(
+        create_solution("ssg", radius=2).get_soln().compile())
+    assert not ok and "stage" in why
+    ok, why = pallas_applicable(
+        create_solution("test_boundary_1d").get_soln().compile())
+    assert not ok
+
+
+def test_pallas_mode_rejects_inapplicable(env):
+    ctx = yk_factory().new_solution(env, stencil="ssg", radius=2)
+    ctx.apply_command_line_options("-g 16")
+    ctx.get_settings().mode = "pallas"
+    with pytest.raises(YaskException):
+        ctx.prepare_solution()

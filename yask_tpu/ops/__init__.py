@@ -1,0 +1,16 @@
+"""Hand-tiled TPU kernels (Pallas).
+
+The performance layer of the framework: where the reference's compiler
+emits AVX-intrinsic nano/pico loops with vector folding and temporal
+wave-front tiling (``src/compiler/lib/CppIntrin.*``, ``context.hpp:331``),
+this package generates Pallas kernels — halo tiles DMA'd HBM→VMEM, K
+time-steps fused in VMEM (temporal tiling), tile shapes searchable by the
+auto-tuner.
+"""
+
+from yask_tpu.ops.pallas_stencil import (
+    pallas_applicable,
+    build_pallas_chunk,
+)
+
+__all__ = ["pallas_applicable", "build_pallas_chunk"]

@@ -1,0 +1,440 @@
+"""Pallas stencil kernels: halo tiles in VMEM + K-step temporal fusion.
+
+This is the TPU replacement for the reference's generated inner loops
+(vector folding + nano/pico loops, ``YaskKernel.cpp:574-676``) *and* its
+temporal wave-front tiling (``context.hpp:331-347``): one kernel invocation
+
+1. DMAs an (bx+2·r·K, by+2·r·K, Nz_padded) halo tile of each input var
+   from HBM into VMEM (the fold/tile planner's job: the minor-most dim
+   stays whole so it rides the 128-lane axis);
+2. applies **K fused time steps** entirely in VMEM — the compute region
+   shrinks by the stencil radius each sub-step (the trapezoid/wavefront
+   shape), and a global-domain mask keeps physical-boundary ghosts at
+   zero between sub-steps (matching the runtime's ghost semantics);
+3. writes the final (and, for 2-slot rings, the previous) time level's
+   interior block back.
+
+HBM traffic per K steps ≈ one read + one write of each var, versus K of
+each for the unfused path — the same arithmetic-intensity win wave-front
+tiling buys the reference.
+
+Applicability (checked by :func:`pallas_applicable`): single stage, no
+sub-domain/step conditions, no scratch vars, no index-value expressions,
+ring allocation ≤ 2, every var spanning all domain dims in the same order.
+Everything else falls back to the XLA-fused path.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+from yask_tpu.utils.exceptions import YaskException
+from yask_tpu.compiler.expr import (
+    AddExpr,
+    ConstExpr,
+    DivExpr,
+    Expr,
+    ExprVisitor,
+    FirstIndexExpr,
+    FuncExpr,
+    IndexExpr,
+    LastIndexExpr,
+    ModExpr,
+    MultExpr,
+    NegExpr,
+    SubExpr,
+    VarPoint,
+)
+
+
+class _NodeScan(ExprVisitor):
+    def __init__(self):
+        self.has_index_values = False
+
+    def visit_index(self, node):
+        self.has_index_values = True
+
+    def visit_first_index(self, node):
+        self.has_index_values = True
+
+    def visit_last_index(self, node):
+        self.has_index_values = True
+
+
+def pallas_applicable(csol) -> Tuple[bool, str]:
+    """Can this solution run on the Pallas fused path?"""
+    ana = csol.ana
+    if len(ana.stages) != 1:
+        return False, "multiple stages"
+    if len(ana.domain_dims) < 2:
+        return False, "needs >= 2 domain dims"
+    for eq in ana.eqs:
+        if eq.cond is not None or eq.step_cond is not None:
+            return False, "has conditions"
+        scan = _NodeScan()
+        eq.rhs.accept(scan)
+        if scan.has_index_values:
+            return False, "uses index values"
+    for v in csol.soln.get_vars():
+        if v.is_scratch():
+            return False, "has scratch vars"
+        if v.misc_dim_names():
+            return False, "has misc dims"
+        if v.domain_dim_names() != ana.domain_dims:
+            return False, f"var '{v.get_name()}' spans a dim subset"
+        if v.is_written and v.get_step_alloc_size() > 2:
+            return False, "ring allocation > 2"
+    return True, "ok"
+
+
+# ---------------------------------------------------------------------------
+
+
+class _TileEval:
+    """Evaluate the (restricted) stencil AST on VMEM tile values.
+
+    ``tiles[name]`` is the ring of tile arrays (oldest→newest); a read at
+    offset ``o`` over compute-region ``lo..hi`` (tile coords, leading dims)
+    slices ``[lo+o : hi+o]``; the minor dim slices with its own origin.
+    """
+
+    def __init__(self, jnp, dims: List[str], step_dir: int,
+                 minor_origin: Dict[str, int]):
+        self.jnp = jnp
+        self.dims = dims
+        self.step_dir = step_dir
+        # per-var pad-left of the minor dim (tiles share leading-dim
+        # geometry, but each var's minor extent is its own padded axis)
+        self.minor_origin = minor_origin
+        from yask_tpu.compiler.lowering import JnpOps
+        self.ops = JnpOps()
+
+    def read(self, p: VarPoint, tiles, computed, region):
+        name = p.var_name()
+        so = p.step_offset()
+        if name in computed and so is not None and so == self.step_dir:
+            arr = computed[name]
+            computed_src = True
+        else:
+            computed_src = False
+            ring = tiles[name]
+            if so is None or not p.get_var().is_written:
+                arr = ring[-1]
+            else:
+                idx = len(ring) - 1 + so * self.step_dir
+                arr = ring[idx]
+        offs = p.domain_offsets()
+        idxs = []
+        for di, (d, (lo, hi)) in enumerate(zip(self.dims, region)):
+            o = offs.get(d, 0)
+            if di == len(self.dims) - 1:
+                if computed_src:
+                    # computed values are region-shaped; same-step reads
+                    # must be offset-free in the single-stage pallas class
+                    if o != 0:
+                        raise YaskException(
+                            "pallas path: same-step read with offset")
+                    idxs.append(slice(None))
+                else:
+                    base = self.minor_origin[name]
+                    idxs.append(slice(base + lo + o, base + hi + o))
+            else:
+                if computed_src:
+                    if o != 0:
+                        raise YaskException(
+                            "pallas path: same-step read with offset")
+                    idxs.append(slice(None))
+                else:
+                    idxs.append(slice(lo + o, hi + o))
+        return arr[tuple(idxs)]
+
+    def eval(self, e: Expr, tiles, computed, region, memo):
+        k = id(e)
+        if k in memo:
+            return memo[k]
+        ev = lambda a: self.eval(a, tiles, computed, region, memo)
+        if isinstance(e, ConstExpr):
+            r = e.value
+        elif isinstance(e, VarPoint):
+            r = self.read(e, tiles, computed, region)
+        elif isinstance(e, NegExpr):
+            r = -ev(e.arg)
+        elif isinstance(e, AddExpr):
+            r = ev(e.args[0])
+            for a in e.args[1:]:
+                r = r + ev(a)
+        elif isinstance(e, MultExpr):
+            r = ev(e.args[0])
+            for a in e.args[1:]:
+                r = r * ev(a)
+        elif isinstance(e, SubExpr):
+            r = ev(e.lhs) - ev(e.rhs)
+        elif isinstance(e, DivExpr):
+            r = ev(e.lhs) / ev(e.rhs)
+        elif isinstance(e, ModExpr):
+            r = ev(e.lhs) % ev(e.rhs)
+        elif isinstance(e, FuncExpr):
+            r = self.ops.func(e.name, [ev(a) for a in e.args])
+        else:  # pragma: no cover - excluded by pallas_applicable
+            raise YaskException(f"pallas path cannot evaluate {type(e)}")
+        memo[k] = r
+        return r
+
+
+# ---------------------------------------------------------------------------
+
+
+def build_pallas_chunk(program, fuse_steps: int = 1,
+                       block: Optional[Tuple[int, ...]] = None,
+                       interpret: bool = False,
+                       vmem_budget: int = 100 * 2 ** 20):
+    """Build ``chunk(state) -> state`` advancing ``fuse_steps`` steps in one
+    fused Pallas sweep.
+
+    ``program`` must be planned with ``extra_pad`` ≥ the fused halo
+    (radius × fuse_steps) in the leading dims — the runtime arranges this.
+    Returns (chunk_fn, tile_bytes).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ana = program.ana
+    dims = ana.domain_dims
+    K = fuse_steps
+    lead = dims[:-1]
+    minor = dims[-1]
+
+    # per-dim stencil radius (max halo over vars)
+    halos = ana.max_halos()
+    rad = {d: max(halos.get(d, (0, 0))) for d in dims}
+    hK = {d: rad[d] * K for d in lead}
+
+    sizes = {d: program.sizes[d] for d in dims}
+    # minor dim: full padded extent lives in the tile
+    some_geom = next(iter(program.geoms.values()))
+
+    # default block: 8 sublanes in the next-to-minor dim, small leading
+    if block is None:
+        block = tuple(8 for _ in lead)
+    block = {d: min(b, sizes[d]) for d, b in zip(lead, block)}
+    for d in lead:
+        if sizes[d] % block[d] != 0:
+            # shrink to a divisor
+            b = block[d]
+            while sizes[d] % b != 0:
+                b -= 1
+            block[d] = b
+
+    var_order = sorted(program.geoms)
+    written = [n for n in var_order if program.geoms[n].is_written]
+
+    # tile geometry per var: leading dims sized block+2hK, minor full padded
+    def tile_shape(name):
+        g = program.geoms[name]
+        shp = []
+        for d in lead:
+            shp.append(block[d] + 2 * hK[d])
+        pl_, pr_ = g.pads[minor]
+        shp.append(sizes[minor] + pl_ + pr_)
+        return tuple(shp)
+
+    dtype = program.dtype
+    esize = jnp.dtype(dtype).itemsize
+    tile_bytes = 0
+    slots: Dict[str, int] = {}
+    for n in var_order:
+        g = program.geoms[n]
+        nslots = len(program_state_slots(program, n))
+        slots[n] = nslots
+        tile_bytes += nslots * int(
+            math.prod(tile_shape(n))) * esize
+    # workspace for sub-step results (rough: one extra tile per written var)
+    tile_bytes += sum(int(math.prod(tile_shape(n))) * esize for n in written)
+    if tile_bytes > vmem_budget:
+        raise YaskException(
+            f"pallas tile needs {tile_bytes/2**20:.1f} MiB VMEM "
+            f"(budget {vmem_budget/2**20:.0f}); shrink block or fuse_steps")
+
+    grid = tuple(sizes[d] // block[d] for d in lead)
+    minor_origin = {n: program.geoms[n].pads[minor][0] for n in var_order}
+    ev = _TileEval(jnp, dims, ana.step_dir, minor_origin)
+
+    stage = ana.stages[0]
+    eqs = [eq for part in stage.parts for eq in part.eqs]
+
+    n_inputs = sum(slots[n] for n in var_order)
+
+    def kernel(*refs):
+        # refs: inputs (ANY/HBM) ..., outputs (VMEM blocks) ...,
+        #       scratch tiles ..., sem
+        ins = refs[:n_inputs]
+        nout = sum(min(slots[n], 2) for n in written)
+        outs = refs[n_inputs:n_inputs + nout]
+        scratch = refs[n_inputs + nout:-1]
+        sem = refs[-1]
+
+        pid = [pl.program_id(i) for i in range(len(lead))]
+
+        # 1) DMA halo tiles HBM → VMEM.
+        dmas = []
+        si = 0
+        for n in var_order:
+            g = program.geoms[n]
+            for s in range(slots[n]):
+                src = ins[si]
+                idxs = []
+                for di, d in enumerate(lead):
+                    start = pid[di] * block[d] + g.origin[d] - hK[d]
+                    idxs.append(pl.ds(start, block[d] + 2 * hK[d]))
+                idxs.append(slice(None))  # minor dim: full extent
+                dma = pltpu.make_async_copy(
+                    src.at[tuple(idxs)], scratch[si], sem.at[si])
+                dma.start()
+                dmas.append(dma)
+                si += 1
+        for dma in dmas:
+            dma.wait()
+
+        # tiles as values
+        tiles: Dict[str, List] = {}
+        si = 0
+        for n in var_order:
+            tiles[n] = []
+            for s in range(slots[n]):
+                tiles[n].append(scratch[si][...])
+                si += 1
+
+        # 2) K fused sub-steps with shrinking compute regions + domain mask.
+        g0 = {n: program.geoms[n] for n in var_order}
+        for k in range(K):
+            # compute region in tile coords (leading dims)
+            region = []
+            for d in lead:
+                lo = rad[d] * (k + 1)
+                hi = block[d] + 2 * hK[d] - rad[d] * (k + 1)
+                region.append((lo, hi))
+            # minor: interior-relative coords (per-var pad origin applied
+            # at read/write time); pads stay zero
+            region.append((0, sizes[minor]))
+
+            # global-domain mask over the region's leading dims
+            mask = None
+            for di, d in enumerate(lead):
+                lo, hi = region[di]
+                gidx = (jnp.arange(lo, hi)
+                        + pid[di] * block[d] - hK[d])
+                m = (gidx >= 0) & (gidx < sizes[d])
+                shape = [1] * len(dims)
+                shape[di] = hi - lo
+                m = m.reshape(shape)
+                mask = m if mask is None else mask & m
+
+            computed: Dict[str, object] = {}
+            memo: Dict = {}
+            for eq in eqs:
+                name = eq.lhs.var_name()
+                val = ev.eval(eq.rhs, tiles, computed, region, memo)
+                val = jnp.asarray(val, dtype=dtype)
+                val = jnp.broadcast_to(
+                    val, tuple(hi - lo for lo, hi in region))
+                if mask is not None:
+                    val = jnp.where(mask, val, jnp.zeros_like(val))
+                computed[name] = val
+
+            # write back into tiles (rotate rings)
+            for name in written:
+                ring = tiles[name]
+                base = ring[0]
+                mo = program.geoms[name].pads[minor][0]
+                idxs = tuple(
+                    slice(lo, hi) for lo, hi in region[:-1]
+                ) + (slice(mo + region[-1][0], mo + region[-1][1]),)
+                newest = base.at[idxs].set(computed[name])
+                if slots[name] >= 2:
+                    tiles[name] = ring[1:] + [newest]
+                else:
+                    tiles[name] = [newest]
+
+        # 3) write final interior block(s).
+        oi = 0
+        for name in written:
+            g = program.geoms[name]
+            ring = tiles[name]
+            keep = min(slots[name], 2)
+            for s in range(keep):
+                src = ring[len(ring) - keep + s]
+                idxs = []
+                for d in lead:
+                    idxs.append(slice(hK[d], hK[d] + block[d]))
+                mlo = g.pads[minor][0]
+                idxs.append(slice(mlo, mlo + sizes[minor]))
+                outs[oi][...] = src[tuple(idxs)]
+                oi += 1
+
+    # ---- pallas_call assembly -------------------------------------------
+
+    out_shapes = []
+    out_specs = []
+    for name in written:
+        keep = min(slots[name], 2)
+        for _ in range(keep):
+            out_shapes.append(jax.ShapeDtypeStruct(
+                tuple(sizes[d] for d in dims), dtype))
+            out_specs.append(pl.BlockSpec(
+                tuple(block[d] for d in lead) + (sizes[minor],),
+                lambda *pid: tuple(pid) + (0,)))
+
+    in_specs = [pl.BlockSpec(memory_space=pltpu.ANY)] * n_inputs
+    scratch_shapes = []
+    for n in var_order:
+        for _ in range(slots[n]):
+            scratch_shapes.append(pltpu.VMEM(tile_shape(n), dtype))
+    scratch_shapes.append(pltpu.SemaphoreType.DMA((n_inputs,)))
+
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        scratch_shapes=scratch_shapes,
+        interpret=interpret,
+    )
+
+    def chunk(state):
+        flat = []
+        for n in var_order:
+            flat.extend(state[n])
+        outs = call(*flat)
+        new_state = dict(state)
+        oi = 0
+        for name in written:
+            g = program.geoms[name]
+            keep = min(slots[name], 2)
+            ring = list(state[name])
+            pads = []
+            for d in dims:
+                pads.append(g.pads[d])
+            news = []
+            for s in range(keep):
+                news.append(jnp.pad(outs[oi], pads))
+                oi += 1
+            # ring after K steps: oldest slots beyond `keep` are dropped
+            # (alloc ≤ 2 enforced), newest two replaced
+            if len(ring) == 1:
+                new_state[name] = [news[-1]]
+            else:
+                new_state[name] = news[-2:]
+        return new_state
+
+    return chunk, tile_bytes
+
+
+def program_state_slots(program, name: str) -> List[int]:
+    g = program.geoms[name]
+    n = g.alloc if (g.has_step and g.is_written) else 1
+    return list(range(n))

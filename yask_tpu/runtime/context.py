@@ -212,6 +212,21 @@ class StencilContext:
             pad_mult = {d: self._opts.num_ranks[d]
                         for d in self._ana.domain_dims
                         if self._opts.num_ranks[d] > 1}
+        if mode == "pallas":
+            # The fused Pallas path needs pad ≥ radius × fuse_steps in the
+            # leading (tiled) dims so halo tiles can be DMA'd whole.
+            from yask_tpu.ops.pallas_stencil import pallas_applicable
+            ok, why = pallas_applicable(self._csol)
+            if not ok:
+                raise YaskException(
+                    f"solution '{self.get_name()}' cannot use the pallas "
+                    f"path: {why}; use -mode jit")
+            K = max(self._opts.wf_steps, 1)
+            halos = self._ana.max_halos()
+            for d in self._ana.domain_dims[:-1]:
+                need = max(halos.get(d, (0, 0))) * K
+                l, r = extra[d]
+                extra[d] = (max(l, need), max(r, need))
         self._plan_kwargs = dict(extra_pad=extra, pad_multiple=pad_mult)
         self._program = self._csol.plan(gsizes, **self._plan_kwargs)
         self._state = self._program.alloc_state()
@@ -334,6 +349,8 @@ class StencilContext:
 
         if self._mode == "ref":
             self._run_ref_steps(start, n)
+        elif self._mode == "pallas":
+            self._run_pallas_steps(start, n)
         elif self._mode == "shard_map":
             from yask_tpu.parallel.shard_step import run_shard_map
             self._state_to_device()
@@ -412,6 +429,43 @@ class StencilContext:
                 t += k * dirn
             jax.block_until_ready(st)
         self._state = st
+
+    def _run_pallas_steps(self, start: int, n: int) -> None:
+        """Advance using the fused Pallas sweep: ⌊n/K⌋ fused chunks (K =
+        wf_steps temporal fusion) plus an XLA-path remainder."""
+        import jax
+        self._state_to_device()
+        K = min(max(self._opts.wf_steps, 1), n)
+        key = ("pallas", K)
+        if key not in self._jit_cache:
+            from yask_tpu.ops.pallas_stencil import build_pallas_chunk
+            blk = None
+            bs = self._opts.block_sizes
+            if any(bs[d] > 0 for d in self._ana.domain_dims[:-1]):
+                blk = tuple(bs[d] if bs[d] > 0 else 8
+                            for d in self._ana.domain_dims[:-1])
+            interp = self._env.get_platform() != "tpu"
+            chunk, tile_bytes = build_pallas_chunk(
+                self._program, fuse_steps=K, block=blk, interpret=interp)
+            t0c = time.perf_counter()
+            fn = jax.jit(chunk) if not interp else chunk
+            self._jit_cache[key] = fn
+            self._compile_secs += time.perf_counter() - t0c
+            self._env.trace_msg(
+                f"pallas chunk: K={K}, tile {tile_bytes / 2**20:.2f} MiB")
+        fn = self._jit_cache[key]
+        groups, rem = divmod(n, K)
+        t = start
+        dirn = self._ana.step_dir
+        with self._run_timer:
+            st = self._state
+            for _ in range(groups):
+                st = fn(st)
+                t += K * dirn
+            jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+        self._state = st
+        if rem:
+            self._run_jit_steps(t, rem)
 
     def run_ref(self, first_step_index: int,
                 last_step_index: Optional[int] = None) -> None:

@@ -25,6 +25,7 @@ from yask_tpu.utils.cli import CommandLineParser
 #: Execution modes for run_solution.
 MODES = ("auto",       # single device → "jit"; >1 rank requested → "sharded"
          "jit",        # single-device jitted jnp program
+         "pallas",     # hand-tiled Pallas kernels w/ K-step temporal fusion
          "sharded",    # global arrays + NamedSharding (XLA inserts comms)
          "shard_map",  # explicit per-shard program + ppermute halo exchange
          "ref",        # eager numpy oracle (the reference's run_ref)
