@@ -1,0 +1,167 @@
+"""Native host library loader (ctypes) with pure-Python fallback.
+
+The reference's runtime substrate is C++ throughout; here the device
+program is XLA-compiled, and this library covers the host-side hot paths
+(layout math, mesh factorization, FD weights, trace scanning). The loader
+builds the .so on first use if a toolchain is available and otherwise
+reports unavailability — callers keep their Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libyask_tpu_host.so")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(["make", "-C", _DIR], capture_output=True,
+                           text=True, timeout=120)
+        return r.returncode == 0 and os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    lib.yt_layout.restype = ctypes.c_int
+    lib.yt_layout.argtypes = [i64p, ctypes.c_int, i64p, ctypes.c_int64, i64p]
+    lib.yt_unlayout.restype = ctypes.c_int
+    lib.yt_unlayout.argtypes = [i64p, ctypes.c_int, i64p, ctypes.c_int64,
+                                i64p]
+    lib.yt_compact_factors.restype = ctypes.c_int
+    lib.yt_compact_factors.argtypes = [ctypes.c_int64, ctypes.c_int, i64p]
+    lib.yt_fd_weights.restype = ctypes.c_int
+    lib.yt_fd_weights.argtypes = [ctypes.c_int, ctypes.c_double, f64p,
+                                  ctypes.c_int, f64p]
+    lib.yt_first_divergence_f32.restype = ctypes.c_int64
+    lib.yt_first_divergence_f32.argtypes = [f32p, f32p, ctypes.c_int64,
+                                            ctypes.c_double, ctypes.c_double]
+    lib.yt_count_divergence_f32.restype = ctypes.c_int64
+    lib.yt_count_divergence_f32.argtypes = [f32p, f32p, ctypes.c_int64,
+                                            ctypes.c_double, ctypes.c_double]
+    lib.yt_version.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _as_i64(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int64)
+
+
+def layout(sizes: Sequence[int], pts: np.ndarray) -> np.ndarray:
+    """Batch N-D→1-D layout (native; raises if lib unavailable)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    s = _as_i64(sizes)
+    p = _as_i64(pts)
+    npts = p.shape[0]
+    out = np.empty(npts, dtype=np.int64)
+    rc = lib.yt_layout(
+        s.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(s),
+        p.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), npts,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if rc != 0:
+        raise ValueError("point out of bounds")
+    return out
+
+
+def unlayout(sizes: Sequence[int], offsets: np.ndarray) -> np.ndarray:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    s = _as_i64(sizes)
+    o = _as_i64(offsets)
+    out = np.empty((len(o), len(s)), dtype=np.int64)
+    rc = lib.yt_unlayout(
+        s.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(s),
+        o.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(o),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if rc != 0:
+        raise ValueError("offset out of bounds")
+    return out
+
+
+def compact_factors(n: int, ndims: int) -> List[int]:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    out = np.empty(ndims, dtype=np.int64)
+    rc = lib.yt_compact_factors(
+        n, ndims, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if rc != 0:
+        raise ValueError(f"cannot factorize {n} into {ndims} dims")
+    return out.tolist()
+
+
+def fd_weights(deriv: int, x0: float, xs: Sequence[float]) -> List[float]:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    x = np.ascontiguousarray(xs, dtype=np.float64)
+    out = np.empty(len(x), dtype=np.float64)
+    rc = lib.yt_fd_weights(
+        deriv, x0, x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(x), out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    if rc != 0:
+        raise ValueError("bad FD parameters")
+    return out.tolist()
+
+
+def first_divergence(a: np.ndarray, b: np.ndarray,
+                     rtol: float = 1e-4, atol: float = 1e-7) -> int:
+    """Index of the first diverging element of two f32 buffers; -1 if
+    none (trace-diff backend)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    x = np.ascontiguousarray(a, dtype=np.float32).ravel()
+    y = np.ascontiguousarray(b, dtype=np.float32).ravel()
+    if x.size != y.size:
+        raise ValueError("size mismatch")
+    return int(lib.yt_first_divergence_f32(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        x.size, rtol, atol))
+
+
+def count_divergence(a: np.ndarray, b: np.ndarray,
+                     rtol: float = 1e-4, atol: float = 1e-7) -> int:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    x = np.ascontiguousarray(a, dtype=np.float32).ravel()
+    y = np.ascontiguousarray(b, dtype=np.float32).ravel()
+    if x.size != y.size:
+        raise ValueError("size mismatch")
+    return int(lib.yt_count_divergence_f32(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        x.size, rtol, atol))

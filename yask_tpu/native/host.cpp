@@ -1,0 +1,177 @@
+// Native host-side runtime kernels for yask_tpu.
+//
+// TPU-native counterpart of the reference's C++ common/runtime substrate:
+// the hot host-side paths that sit outside the XLA device program —
+// N-D layout math (reference Tuple<T>, src/common/tuple.hpp:130),
+// rank-grid factorization (get_compact_factors, setup.cpp:230),
+// finite-difference weight generation (fd_coeff2.cpp), and the
+// trace-divergence scanner backing the analyze_trace tooling
+// (utils/bin/analyze_trace.pl). Exposed with a plain C ABI for ctypes;
+// Python falls back to pure-Python implementations when the library
+// isn't built.
+//
+// Build: make -C yask_tpu/native   (or python -m yask_tpu.native.build)
+
+#include <cstdint>
+#include <cstddef>
+#include <cmath>
+#include <vector>
+#include <algorithm>
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// N-D layout math (Tuple::layout / unlayout, last dim unit-stride)
+// ---------------------------------------------------------------------
+
+// Map npts N-D points (pts[i*ndims + d]) to 1-D offsets under `sizes`.
+// Returns 0 on success, -1 on out-of-bounds.
+int yt_layout(const int64_t* sizes, int ndims,
+              const int64_t* pts, int64_t npts, int64_t* out) {
+    for (int64_t i = 0; i < npts; ++i) {
+        int64_t idx = 0;
+        const int64_t* p = pts + i * ndims;
+        for (int d = 0; d < ndims; ++d) {
+            if (p[d] < 0 || p[d] >= sizes[d]) return -1;
+            idx = idx * sizes[d] + p[d];
+        }
+        out[i] = idx;
+    }
+    return 0;
+}
+
+// Inverse: 1-D offsets to N-D points.
+int yt_unlayout(const int64_t* sizes, int ndims,
+                const int64_t* offsets, int64_t n, int64_t* out) {
+    int64_t total = 1;
+    for (int d = 0; d < ndims; ++d) total *= sizes[d];
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t off = offsets[i];
+        if (off < 0 || off >= total) return -1;
+        for (int d = ndims - 1; d >= 0; --d) {
+            out[i * ndims + d] = off % sizes[d];
+            off /= sizes[d];
+        }
+    }
+    return 0;
+}
+
+// ---------------------------------------------------------------------
+// Compact factorization of n over ndims grid dims (rank/mesh grids):
+// minimize spread (max/min), prefer larger factors later.
+// ---------------------------------------------------------------------
+
+static void factor_rec(int64_t rem, int dims_left,
+                       std::vector<int64_t>& acc,
+                       std::vector<int64_t>& best, double& best_spread,
+                       int& best_sorted) {
+    if (dims_left == 1) {
+        acc.push_back(rem);
+        int64_t mx = *std::max_element(acc.begin(), acc.end());
+        int64_t mn = *std::min_element(acc.begin(), acc.end());
+        double spread = (double)mx / (double)(mn > 0 ? mn : 1);
+        int sorted = 0;
+        for (size_t i = 0; i + 1 < acc.size(); ++i)
+            if (acc[i] > acc[i + 1]) ++sorted;
+        if (best.empty() || spread < best_spread ||
+            (spread == best_spread && sorted < best_sorted)) {
+            best = acc;
+            best_spread = spread;
+            best_sorted = sorted;
+        }
+        acc.pop_back();
+        return;
+    }
+    for (int64_t f = 1; f <= rem; ++f) {
+        if (rem % f == 0) {
+            acc.push_back(f);
+            factor_rec(rem / f, dims_left - 1, acc, best, best_spread,
+                       best_sorted);
+            acc.pop_back();
+        }
+    }
+}
+
+int yt_compact_factors(int64_t n, int ndims, int64_t* out) {
+    if (ndims <= 0 || n <= 0) return -1;
+    std::vector<int64_t> acc, best;
+    double spread = 0.0;
+    int sorted = 0;
+    factor_rec(n, ndims, acc, best, spread, sorted);
+    if ((int)best.size() != ndims) return -1;
+    for (int d = 0; d < ndims; ++d) out[d] = best[d];
+    return 0;
+}
+
+// ---------------------------------------------------------------------
+// Fornberg finite-difference weights: order-d derivative at x0 over
+// sample points xs[0..n) (fd_coeff API backing).
+// ---------------------------------------------------------------------
+
+int yt_fd_weights(int d, double x0, const double* xs, int n, double* out) {
+    if (n < 2 || d < 1 || d >= n) return -1;
+    std::vector<std::vector<double>> c(d + 1, std::vector<double>(n, 0.0));
+    c[0][0] = 1.0;
+    double c1 = 1.0;
+    double c4 = xs[0] - x0;
+    for (int i = 1; i < n; ++i) {
+        int mn = std::min(i, d);
+        double c2 = 1.0;
+        double c5 = c4;
+        c4 = xs[i] - x0;
+        for (int j = 0; j < i; ++j) {
+            double c3 = xs[i] - xs[j];
+            c2 *= c3;
+            if (j == i - 1) {
+                for (int k = mn; k >= 1; --k)
+                    c[k][i] = c1 * (k * c[k - 1][i - 1]
+                                    - c5 * c[k][i - 1]) / c2;
+                c[0][i] = -c1 * c5 * c[0][i - 1] / c2;
+            }
+            for (int k = mn; k >= 1; --k)
+                c[k][j] = (c4 * c[k][j] - k * c[k - 1][j]) / c3;
+            c[0][j] = c4 * c[0][j] / c3;
+        }
+        c1 = c2;
+    }
+    for (int i = 0; i < n; ++i) out[i] = c[d][i];
+    return 0;
+}
+
+// ---------------------------------------------------------------------
+// Trace divergence scan: first index where |a-b| > atol + rtol*max(|a|,|b|)
+// over float32 buffers (the analyze_trace first-divergent-write search).
+// Returns index, or -1 if none, -2 on bad args.
+// ---------------------------------------------------------------------
+
+int64_t yt_first_divergence_f32(const float* a, const float* b, int64_t n,
+                                double rtol, double atol) {
+    if (!a || !b || n < 0) return -2;
+    for (int64_t i = 0; i < n; ++i) {
+        double x = a[i], y = b[i];
+        double tol = atol + rtol * std::max(std::fabs(x), std::fabs(y));
+        double diff = std::fabs(x - y);
+        bool xn = std::isnan(x), yn = std::isnan(y);
+        if (xn != yn || (!xn && diff > tol)) return i;
+    }
+    return -1;
+}
+
+// Count of diverging elements (bulk compare used by compare_data).
+int64_t yt_count_divergence_f32(const float* a, const float* b, int64_t n,
+                                double rtol, double atol) {
+    if (!a || !b || n < 0) return -2;
+    int64_t bad = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        double x = a[i], y = b[i];
+        double tol = atol + rtol * std::max(std::fabs(x), std::fabs(y));
+        double diff = std::fabs(x - y);
+        bool xn = std::isnan(x), yn = std::isnan(y);
+        if (xn != yn || (!xn && diff > tol)) ++bad;
+    }
+    return bad;
+}
+
+int yt_version() { return 1; }
+
+}  // extern "C"

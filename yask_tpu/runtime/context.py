@@ -85,6 +85,7 @@ class StencilContext:
         self._hooks: Dict[str, List[Callable]] = {
             "before_prepare": [], "after_prepare": [],
             "before_run": [], "after_run": []}
+        self._trace_dir: Optional[str] = None
 
     # ------------------------------------------------------------------
     # identity / settings / vars
@@ -343,6 +344,18 @@ class StencilContext:
             h(self)
         start, n = self._step_seq(first_step_index, last_step_index)
 
+        # Trace mode: advance one step at a time, dumping written state
+        # after each (trace_mem analog; run_solution recursion keeps every
+        # execution path identical to the untraced one).
+        if self._trace_dir and n > 1:
+            t = start
+            for _ in range(n):
+                self.run_solution(t, t)
+                t += self._ana.step_dir
+            for h in self._hooks["after_run"]:
+                h(self)
+            return
+
         if self._opts.do_auto_tune and self._mode in ("jit", "sharded"):
             from yask_tpu.runtime.auto_tuner import AutoTuner
             AutoTuner(self).tune_if_needed()
@@ -363,6 +376,8 @@ class StencilContext:
         self._cur_step = start + (n - 1) * self._ana.step_dir \
             + self._ana.step_dir
         self._steps_done += n
+        if self._trace_dir:
+            self._trace_dump(self._cur_step)
         for h in self._hooks["after_run"]:
             h(self)
 
@@ -537,6 +552,80 @@ class StencilContext:
                 tol = abs_epsilon + epsilon * np.maximum(np.abs(x), np.abs(y))
                 bad += int((np.abs(x - y) > tol).sum())
         return bad
+
+    # ------------------------------------------------------------------
+    # tracing (SURVEY §5: trace_mem analog — per-step write dumps,
+    # diffable by tools/analyze_trace to find the first divergent write)
+    # ------------------------------------------------------------------
+
+    def set_trace_dir(self, path: Optional[str]) -> None:
+        """Enable per-step state dumps into ``path`` (one .npz per step,
+        interiors of all written vars). The runtime then advances steps
+        one at a time so each step's writes are observable — the analog of
+        the reference's ``trace_mem=1`` builds (``common_utils.hpp:201``)."""
+        self._trace_dir = path
+        if path:
+            import os
+            os.makedirs(path, exist_ok=True)
+
+    def _trace_dump(self, t_written: int) -> None:
+        import os
+        arrs = {}
+        for name, ring in self._state.items():
+            g = self._program.geoms[name]
+            if not g.is_written:
+                continue
+            idxs = []
+            for dn, kind in g.axes:
+                if kind == "domain":
+                    idxs.append(slice(
+                        g.origin[dn],
+                        g.origin[dn] + self._opts.global_domain_sizes[dn]))
+                else:
+                    idxs.append(slice(None))
+            arrs[name] = np.asarray(ring[-1])[tuple(idxs)]
+        np.savez(os.path.join(self._trace_dir, f"step_{t_written}.npz"),
+                 **arrs)
+
+    # ------------------------------------------------------------------
+    # checkpoint / resume (SURVEY §5: the reference has none; the slice
+    # get/set API defines the serialization surface — we provide whole-
+    # solution snapshot/restore on top of the same state)
+    # ------------------------------------------------------------------
+
+    def save_checkpoint(self, path: str) -> None:
+        """Snapshot all var state + step position to an .npz file."""
+        self._check_prepared()
+        payload = {"__cur_step__": np.asarray(self._cur_step),
+                   "__steps_done__": np.asarray(self._steps_done)}
+        for name, ring in self._state.items():
+            for i, a in enumerate(ring):
+                payload[f"{name}__slot{i}"] = np.asarray(a)
+        np.savez(path, **payload)
+
+    def load_checkpoint(self, path: str) -> None:
+        """Restore a snapshot (shapes must match the prepared geometry)."""
+        self._check_prepared()
+        data = np.load(path)
+        new_state: Dict[str, List] = {}
+        for name, ring in self._state.items():
+            arrs = []
+            for i, old in enumerate(ring):
+                key = f"{name}__slot{i}"
+                if key not in data:
+                    raise YaskException(f"checkpoint missing '{key}'")
+                a = data[key]
+                if tuple(a.shape) != tuple(np.asarray(old).shape):
+                    raise YaskException(
+                        f"checkpoint shape mismatch for '{name}': "
+                        f"{a.shape} vs {np.asarray(old).shape}")
+                arrs.append(a)
+            new_state[name] = arrs
+        self._state = new_state
+        self._state_on_device = False
+        self._state_to_device()
+        self._cur_step = int(data["__cur_step__"])
+        self._steps_done = int(data["__steps_done__"])
 
     # ------------------------------------------------------------------
     # stats (yk_stats)

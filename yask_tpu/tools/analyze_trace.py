@@ -1,0 +1,106 @@
+"""Locate the first divergent write between two trace-dump directories.
+
+Counterpart of the reference's ``utils/bin/analyze_trace.pl`` (:26): compare
+every point write between two runs (e.g. optimized vs reference, or two
+framework versions) and report the first step/var/coordinates where they
+diverge — the debugging tool for localizing a miscompiled stencil.
+
+Traces are produced by ``StencilContext.set_trace_dir`` (one ``.npz`` of all
+written-var interiors per step). The scan uses the native C++ library when
+built (``yt_first_divergence_f32``) and falls back to numpy.
+
+Usage::
+
+    python -m yask_tpu.tools.analyze_trace runA_trace/ runB_trace/ \
+        [-rtol 1e-4] [-atol 1e-7]
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _first_divergence(a: np.ndarray, b: np.ndarray, rtol: float,
+                      atol: float) -> int:
+    try:
+        from yask_tpu import native
+        if native.available() and a.dtype == np.float32 \
+                and b.dtype == np.float32:
+            return native.first_divergence(a, b, rtol, atol)
+    except Exception:
+        pass
+    x = a.astype(np.float64).ravel()
+    y = b.astype(np.float64).ravel()
+    bad = np.abs(x - y) > (atol + rtol * np.maximum(np.abs(x), np.abs(y)))
+    bad |= np.isnan(x) != np.isnan(y)
+    idx = np.flatnonzero(bad)
+    return int(idx[0]) if idx.size else -1
+
+
+def _steps(d: str):
+    pat = re.compile(r"step_(-?\d+)\.npz$")
+    out = []
+    for f in os.listdir(d):
+        m = pat.match(f)
+        if m:
+            out.append((int(m.group(1)), os.path.join(d, f)))
+    return sorted(out)
+
+
+def compare_traces(dir_a: str, dir_b: str, rtol: float = 1e-4,
+                   atol: float = 1e-7
+                   ) -> Optional[Tuple[int, str, Tuple[int, ...], float, float]]:
+    """Return (step, var, coords, value_a, value_b) of the first divergent
+    write, or None if the traces agree."""
+    sa = dict(_steps(dir_a))
+    sb = dict(_steps(dir_b))
+    for t in sorted(set(sa) & set(sb)):
+        da = np.load(sa[t])
+        db = np.load(sb[t])
+        for var in sorted(set(da.files) & set(db.files)):
+            a, b = da[var], db[var]
+            if a.shape != b.shape:
+                return (t, var, (), float("nan"), float("nan"))
+            i = _first_divergence(np.ascontiguousarray(a),
+                                  np.ascontiguousarray(b), rtol, atol)
+            if i >= 0:
+                coords = tuple(int(c) for c in
+                               np.unravel_index(i, a.shape))
+                return (t, var, coords,
+                        float(a[coords]), float(b[coords]))
+    return None
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    rtol, atol = 1e-4, 1e-7
+    dirs = []
+    i = 0
+    while i < len(argv):
+        if argv[i] == "-rtol":
+            rtol = float(argv[i + 1]); i += 2
+        elif argv[i] == "-atol":
+            atol = float(argv[i + 1]); i += 2
+        else:
+            dirs.append(argv[i]); i += 1
+    if len(dirs) != 2:
+        sys.stderr.write("usage: analyze_trace <dirA> <dirB> "
+                         "[-rtol R] [-atol A]\n")
+        return 2
+    res = compare_traces(dirs[0], dirs[1], rtol, atol)
+    if res is None:
+        print("traces agree (within tolerance)")
+        return 0
+    t, var, coords, va, vb = res
+    print(f"FIRST DIVERGENCE: step {t}, var '{var}', point {coords}: "
+          f"{va!r} vs {vb!r}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

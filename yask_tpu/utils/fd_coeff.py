@@ -24,7 +24,11 @@ from yask_tpu.utils.exceptions import YaskException
 
 def _fornberg_weights(d: int, x0: float, xs: Sequence[float]) -> List[float]:
     """Fornberg finite-difference weights for the d-th derivative at x0
-    given sample points xs. Returns one weight per sample point."""
+    given sample points xs. Returns one weight per sample point.
+
+    Uses the native C++ implementation (``yask_tpu/native/host.cpp``,
+    ``yt_fd_weights``) when built; this Python path is the fallback and
+    the executable specification."""
     n = len(xs)
     if n < 2:
         raise YaskException("need at least 2 sample points for FD coefficients")
@@ -33,6 +37,12 @@ def _fornberg_weights(d: int, x0: float, xs: Sequence[float]) -> List[float]:
     if d >= n:
         raise YaskException(
             f"derivative order {d} needs more than {n} sample points")
+    try:
+        from yask_tpu import native
+        if native.available():
+            return native.fd_weights(d, x0, list(xs))
+    except Exception:
+        pass
     # c[k][j]: weight of xs[j] for the k-th derivative using points xs[0..i].
     c = [[0.0] * n for _ in range(d + 1)]
     c[0][0] = 1.0

@@ -279,6 +279,18 @@ class IdxTuple:
                 raise YaskException("cannot factorize into 0 dims")
             return self.copy()
 
+        # Native fast path (the recursion is exponential in ndims).
+        try:
+            from yask_tpu import native
+            if native.available():
+                vals = native.compact_factors(n, ndims)
+                out = self.copy()
+                for name, val in zip(out.get_dim_names(), vals):
+                    out._map[name] = val
+                return out
+        except (ImportError, ValueError):
+            pass
+
         best: Optional[List[int]] = None
         best_score: Optional[Tuple[float, int]] = None
 
