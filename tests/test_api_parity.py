@@ -1,0 +1,107 @@
+"""API-parity tests: compiler CLI, fixed-size vars, fuse_vars, device
+copies, auto mesh factorization, HLO viewer."""
+
+import io
+
+import numpy as np
+import pytest
+
+from yask_tpu import yk_factory
+from yask_tpu.compiler.__main__ import run_compiler
+
+
+@pytest.fixture(scope="module")
+def env():
+    return yk_factory().new_env()
+
+
+def test_compiler_cli_pseudo():
+    out = io.StringIO()
+    rc = run_compiler(["-stencil", "3axis", "-radius", "1",
+                       "-target", "pseudo", "-p", "-"], out=out)
+    assert rc == 0
+
+
+def test_compiler_cli_file_and_pyapi(tmp_path):
+    p = str(tmp_path / "gen.py")
+    out = io.StringIO()
+    rc = run_compiler(["-stencil", "iso3dfd", "-radius", "2",
+                       "-target", "py-api", "-p", p], out=out)
+    assert rc == 0
+    ns = {}
+    exec(open(p).read(), ns)
+    assert ns["get_solution"]().get_num_equations() == 1
+
+
+def test_compiler_cli_list_and_errors():
+    out = io.StringIO()
+    assert run_compiler(["-list"], out=out) == 0
+    assert "awp" in out.getvalue()
+    from yask_tpu.utils.exceptions import YaskException
+    with pytest.raises(YaskException):
+        run_compiler(["-stencil", "3axis", "-bogus", "1"], out=io.StringIO())
+
+
+def test_fixed_size_var(env):
+    ctx = yk_factory().new_solution(env, stencil="3axis", radius=1)
+    v = ctx.new_fixed_size_var("staging", ["a", "b"], [4, 6])
+    assert v.is_fixed_size()
+    assert v.get_alloc_size("b") == 6
+    v.set_element(2.5, [1, 2])
+    assert v.get_element([1, 2]) == 2.5
+    v.set_elements_in_slice(np.ones((2, 3), np.float32), [0, 0], [1, 2])
+    # the slice overwrote [1,2]; total = six ones
+    assert v.reduce_elements_in_slice("sum", [0, 0], [3, 5]) \
+        == pytest.approx(6.0)
+
+
+def test_fuse_vars_and_device_copies(env):
+    def make():
+        c = yk_factory().new_solution(env, stencil="3axis", radius=1)
+        c.apply_command_line_options("-g 12")
+        c.prepare_solution()
+        return c
+    a, b = make(), make()
+    a.get_var("A").set_elements_in_seq(0.1)
+    b.fuse_vars(a)
+    assert b.compare_data(a) == 0
+    b.copy_vars_from_device()
+    assert not b._state_on_device
+    b.copy_vars_to_device()
+    assert b._state_on_device
+    b.run_solution(0, 1)
+    a.run_solution(0, 1)
+    assert b.compare_data(a) == 0
+
+
+def test_auto_mesh_factorization(env):
+    if env.get_num_ranks() < 8:
+        pytest.skip("needs 8 virtual devices")
+    ctx = yk_factory().new_solution(env, stencil="3axis", radius=1)
+    ctx.apply_command_line_options("-g 16 -mode sharded")
+    ctx.set_num_ranks("x", -1)   # auto-factorize
+    ctx.prepare_solution()
+    nr = ctx.get_settings().num_ranks
+    assert nr.product() == env.get_num_ranks()
+    assert nr["z"] == 1          # minor dim kept whole
+
+
+def test_view_hlo():
+    from yask_tpu.tools.view_hlo import view_hlo
+    txt = view_hlo("3axis", g=12, radius=1)
+    assert "stablehlo" in txt
+    opt = view_hlo("3axis", g=12, radius=1, optimized=True)
+    assert "fusion" in opt or "HloModule" in opt
+
+
+def test_tile_planner_respects_fold_hints():
+    from yask_tpu.utils.idx_tuple import IdxTuple
+    from yask_tpu.compiler.solution_base import create_solution
+    from yask_tpu.ops.tile_planner import plan_blocks
+    sb = create_solution("3axis", radius=1)
+    sb.get_soln().set_fold_len("x", 4)
+    csol = sb.get_soln().compile()
+    prog = csol.plan(IdxTuple(x=32, y=32, z=32))
+    blocks = plan_blocks(prog, fuse_steps=1)
+    assert blocks["x"] in (4, 8, 16, 32)   # grown only by doubling
+    assert set(blocks) == {"x", "y"}

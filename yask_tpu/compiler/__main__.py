@@ -1,0 +1,103 @@
+"""Compiler driver CLI.
+
+Counterpart of the reference's ``yask_compiler.exe``
+(``src/compiler/compiler_main.cpp:158``): pick a registered stencil, set
+radius/target, run ``define()``, and write the output artifact — here a
+pseudo/dot/py-api text or (for TPU targets) the generated Python module
+that rebuilds the solution.
+
+Usage::
+
+    python -m yask_tpu.compiler -stencil iso3dfd -radius 8 -target pseudo -p -
+    python -m yask_tpu.compiler -stencil ssg -target py-api -p ssg_gen.py
+    python -m yask_tpu.compiler -list
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from yask_tpu.utils.cli import CommandLineParser
+from yask_tpu.utils.exceptions import YaskException
+from yask_tpu.utils.output import yask_output_factory
+
+
+class CompilerCLISettings:
+    def __init__(self):
+        self.stencil = ""
+        self.radius = 0
+        self.target = "pseudo"
+        self.path = "-"
+        self.elem_bytes = 4
+        self.fold = ""
+        self.list_stencils = False
+        self.help = False
+
+    def add_options(self, p: CommandLineParser):
+        p.add_string_option("stencil", "Registered stencil name.",
+                            self, "stencil")
+        p.add_int_option("radius", "Stencil radius (0 = default).",
+                         self, "radius")
+        p.add_string_option(
+            "target", "Output target: tpu|jnp|pallas|pseudo|pseudo-long|"
+            "dot|dot-lite|py-api.", self, "target")
+        p.add_string_option("p", "Output path ('-' = stdout).",
+                            self, "path")
+        p.add_int_option("elem-bytes", "FP element size (2|4|8).",
+                         self, "elem_bytes")
+        p.add_string_option("fold", "Tile-shape hint 'x=8,y=128'.",
+                            self, "fold")
+        p.add_bool_option("list", "List registered stencils.",
+                          self, "list_stencils")
+        p.add_bool_option("help", "Print help.", self, "help")
+
+
+def run_compiler(argv: Optional[List[str]] = None, out=None) -> int:
+    out = out or sys.stdout
+    opts = CompilerCLISettings()
+    p = CommandLineParser()
+    opts.add_options(p)
+    rest = p.parse_args(list(argv if argv is not None else sys.argv[1:]))
+    if opts.help:
+        p.print_help(out)
+        return 0
+    from yask_tpu.compiler.solution_base import (
+        create_solution, get_registered_solutions)
+    if opts.list_stencils:
+        out.write("\n".join(get_registered_solutions()) + "\n")
+        return 0
+    if rest:
+        raise YaskException(f"unrecognized options: {' '.join(rest)}")
+    if not opts.stencil:
+        out.write("error: -stencil <name> required; -list to enumerate.\n")
+        return 2
+    sb = create_solution(opts.stencil, radius=opts.radius or None)
+    soln = sb.get_soln()
+    soln.set_target(opts.target)
+    soln.set_element_bytes(opts.elem_bytes)
+    if opts.fold:
+        from yask_tpu.utils.idx_tuple import parse_dim_val_str
+        for d, v in parse_dim_val_str(opts.fold).items():
+            soln.set_fold_len(d, v)
+    fac = yask_output_factory()
+    sink = fac.new_stdout_output() if opts.path == "-" \
+        else fac.new_file_output(opts.path)
+    soln.output_solution(sink)
+    if opts.path != "-":
+        sink.close()
+        out.write(f"wrote {opts.target} output for '{opts.stencil}' "
+                  f"to {opts.path}\n")
+    return 0
+
+
+def main() -> None:  # pragma: no cover - thin wrapper
+    try:
+        sys.exit(run_compiler())
+    except YaskException as e:
+        sys.stderr.write(f"error: {e}\n")
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

@@ -217,10 +217,12 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # minor dim: full padded extent lives in the tile
     some_geom = next(iter(program.geoms.values()))
 
-    # default block: 8 sublanes in the next-to-minor dim, small leading
+    # default block: from the tile planner (fold hints → VREG mapping)
     if block is None:
-        block = tuple(8 for _ in lead)
-    block = {d: min(b, sizes[d]) for d, b in zip(lead, block)}
+        from yask_tpu.ops.tile_planner import plan_blocks
+        block = plan_blocks(program, fuse_steps=K, vmem_budget=vmem_budget)
+    else:
+        block = {d: min(b, sizes[d]) for d, b in zip(lead, block)}
     for d in lead:
         if sizes[d] % block[d] != 0:
             # shrink to a divisor

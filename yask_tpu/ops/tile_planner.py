@@ -1,0 +1,95 @@
+"""Tile planner: map stencil geometry onto TPU register/VMEM tiling.
+
+Counterpart of the reference's vector-folding planner
+(``src/compiler/lib/Vec.*``): where YASK chooses an N-D SIMD fold (e.g.
+4×4 for 16 lanes) to maximize in-register reuse between neighboring
+stencil reads, the TPU equivalent chooses which dims ride the VREG
+(sublane, lane) axes and what Pallas block shape to use:
+
+* the minor-most dim is the 128-lane axis and stays whole in each tile;
+* the next-to-minor dim maps to sublanes — blocks should be multiples of
+  the dtype's sublane count (8 for f32, 16 for bf16);
+* remaining leading dims get small blocks sized to fit the VMEM budget
+  given the fused halo (radius × fuse_steps).
+
+User fold hints (``yc_solution.set_fold_len``, the reference's ``-fold``)
+override the defaults per dim; the auto-tuner searches around the plan.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+
+def sublane_count(dtype) -> int:
+    import numpy as np
+    size = np.dtype(dtype).itemsize
+    return {4: 8, 2: 16, 1: 32}.get(size, 8)
+
+
+def plan_blocks(program, fuse_steps: int = 1,
+                vmem_budget: int = 100 * 2 ** 20) -> Dict[str, int]:
+    """Choose leading-dim block sizes for the Pallas path."""
+    ana = program.ana
+    dims = ana.domain_dims
+    lead = dims[:-1]
+    minor = dims[-1]
+    sizes = {d: program.sizes[d] for d in dims}
+    halos = ana.max_halos()
+    rad = {d: max(halos.get(d, (0, 0))) for d in lead}
+    hK = {d: rad[d] * fuse_steps for d in lead}
+    sub = sublane_count(program.dtype)
+
+    fold = program.soln.get_settings().fold
+
+    # initial guess: fold hints, else sublane multiple for next-to-minor,
+    # small for outers
+    block: Dict[str, int] = {}
+    for i, d in enumerate(lead):
+        if fold.has_dim(d) and fold[d] > 0:
+            block[d] = min(fold[d], sizes[d])
+        elif i == len(lead) - 1:
+            block[d] = min(max(sub, 8), sizes[d])
+        else:
+            block[d] = min(8, sizes[d])
+
+    # fit to divisors
+    for d in lead:
+        b = block[d]
+        while sizes[d] % b != 0:
+            b -= 1
+        block[d] = max(b, 1)
+
+    # estimate VMEM need and grow blocks while they fit (bigger tiles
+    # amortize halo overlap)
+    import numpy as np
+    esize = np.dtype(program.dtype).itemsize
+    nbuf = 0
+    minor_ext = 0
+    for n, g in program.geoms.items():
+        slots = g.alloc if (g.has_step and g.is_written) else 1
+        nbuf += slots + (1 if g.is_written else 0)
+        pl_, pr_ = g.pads[minor]
+        minor_ext = max(minor_ext, sizes[minor] + pl_ + pr_)
+
+    def tile_bytes(blk):
+        per = 1
+        for d in lead:
+            per *= blk[d] + 2 * hK[d]
+        return per * minor_ext * esize * max(nbuf, 1)
+
+    improved = True
+    while improved and tile_bytes(block) < vmem_budget // 2:
+        improved = False
+        for d in reversed(lead):  # grow the sublane dim first
+            cand = dict(block)
+            nb = block[d] * 2
+            while nb <= sizes[d] and sizes[d] % nb != 0:
+                nb *= 2
+            if nb <= sizes[d]:
+                cand[d] = nb
+                if tile_bytes(cand) < vmem_budget // 2:
+                    block = cand
+                    improved = True
+    return block

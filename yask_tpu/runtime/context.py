@@ -153,6 +153,43 @@ class StencilContext:
     def get_vars(self) -> List[yk_var]:
         return list(self._vars.values())
 
+    def new_fixed_size_var(self, name: str, dim_names, dim_sizes):
+        """Create standalone N-D storage with the var data API
+        (``yk_solution::new_fixed_size_var``); not part of stepping."""
+        from yask_tpu.runtime.var import FixedSizeVar
+        v = FixedSizeVar(name, list(dim_names), list(dim_sizes))
+        self._fixed_vars = getattr(self, "_fixed_vars", {})
+        self._fixed_vars[name] = v
+        return v
+
+    def copy_vars_to_device(self) -> None:
+        """Force state onto device (``yk_solution::copy_vars_to_device``;
+        mostly a no-op here since runs keep state resident)."""
+        self._check_prepared()
+        self._state_to_device()
+
+    def copy_vars_from_device(self) -> None:
+        self._check_prepared()
+        self._state_to_host()
+
+    def fuse_vars(self, other: "StencilContext") -> None:
+        """Share storage with another prepared context where var geometry
+        matches (``yk_solution::fuse_vars``, used by the reference's
+        validation flow to alias vars between solutions). Arrays are
+        immutable under JAX, so sharing is simply adopting references."""
+        self._check_prepared()
+        other._check_prepared()
+        for name, ring in other._state.items():
+            if name not in self._state:
+                continue
+            mine = self._state[name]
+            if len(mine) != len(ring):
+                continue
+            ok = all(tuple(np.asarray(a).shape) == tuple(np.asarray(b).shape)
+                     for a, b in zip(mine, ring))
+            if ok:
+                self._state[name] = list(ring)
+
     def first_domain_index(self, dim: str) -> int:
         return 0
 

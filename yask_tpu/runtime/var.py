@@ -264,3 +264,64 @@ def _np_set(a, idx, val):
     out = np.array(a)
     out[idx] = val
     return out
+
+
+class FixedSizeVar:
+    """A runtime-created var outside any solution (``yk_solution::
+    new_fixed_size_var``, reference fixed-size vars ``yk_var.hpp``): plain
+    N-D storage with the element/slice API, used for staging user data.
+    Not part of the step program."""
+
+    def __init__(self, name: str, dim_names: List[str],
+                 dim_sizes: List[int], dtype=np.float32):
+        if len(dim_names) != len(dim_sizes):
+            raise YaskException("dim names/sizes length mismatch")
+        self._name = name
+        self._dims = list(dim_names)
+        self._arr = np.zeros(tuple(int(s) for s in dim_sizes), dtype=dtype)
+
+    def get_name(self) -> str:
+        return self._name
+
+    def get_num_dims(self) -> int:
+        return len(self._dims)
+
+    def get_dim_names(self) -> List[str]:
+        return list(self._dims)
+
+    def is_fixed_size(self) -> bool:
+        return True
+
+    def get_alloc_size(self, dim: str) -> int:
+        return self._arr.shape[self._dims.index(dim)]
+
+    def get_element(self, indices) -> float:
+        return float(self._arr[tuple(int(i) for i in indices)])
+
+    def set_element(self, val: float, indices) -> int:
+        self._arr[tuple(int(i) for i in indices)] = val
+        return 1
+
+    def get_elements_in_slice(self, first_indices, last_indices) -> np.ndarray:
+        idx = tuple(slice(int(a), int(b) + 1)
+                    for a, b in zip(first_indices, last_indices))
+        return np.array(self._arr[idx])
+
+    def set_elements_in_slice(self, buf, first_indices, last_indices) -> int:
+        idx = tuple(slice(int(a), int(b) + 1)
+                    for a, b in zip(first_indices, last_indices))
+        data = np.asarray(buf)
+        self._arr[idx] = data.reshape(self._arr[idx].shape)
+        return int(data.size)
+
+    def set_all_elements_same(self, val: float) -> None:
+        self._arr.fill(val)
+
+    def reduce_elements_in_slice(self, op, first_indices, last_indices):
+        d = self.get_elements_in_slice(first_indices,
+                                       last_indices).astype(np.float64)
+        return {"sum": d.sum, "add": d.sum, "product": d.prod,
+                "mul": d.prod, "min": d.min, "max": d.max}[op]()
+
+    def as_numpy(self) -> np.ndarray:
+        return self._arr

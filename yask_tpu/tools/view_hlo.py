@@ -1,0 +1,78 @@
+"""Inspect the compiled program for a stencil step.
+
+Counterpart of the reference's ``utils/bin/view_asm.pl`` (:26), which
+annotates compiler asm output for inner-loop inspection: here the "asm" is
+XLA's output — this tool prints the StableHLO (pre-optimization) or the
+optimized backend HLO for one compiled step of a solution, so kernel fusion
+and collective placement can be inspected.
+
+Usage::
+
+    python -m yask_tpu.tools.view_hlo -stencil 3axis -g 32 [-radius N]
+        [-optimized] [-steps K]
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+
+def view_hlo(stencil: str, g: int = 32, radius: Optional[int] = None,
+             optimized: bool = False, steps: int = 1, out=None) -> str:
+    import jax
+    from jax import lax
+    from yask_tpu.utils.idx_tuple import IdxTuple
+    from yask_tpu.compiler.solution_base import create_solution
+
+    sb = create_solution(stencil, radius=radius)
+    csol = sb.get_soln().compile()
+    dims = csol.ana.domain_dims
+    sizes = IdxTuple({d: g for d in dims})
+    prog = csol.plan(sizes)
+    state = prog.alloc_state()
+    dirn = csol.ana.step_dir
+
+    def chunk(state, t0):
+        def body(carry, _):
+            st, t = carry
+            return (prog.step(st, t), t + dirn), None
+        (st, _), _ = lax.scan(body, (state, t0), None, length=steps)
+        return st
+
+    lowered = jax.jit(chunk).lower(state, 0)
+    text = (lowered.compile().as_text() if optimized
+            else lowered.as_text())
+    if out is not None:
+        out.write(text)
+    return text
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    stencil, g, radius, optimized, steps = "", 32, None, False, 1
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "-stencil":
+            stencil = argv[i + 1]; i += 2
+        elif a == "-g":
+            g = int(argv[i + 1]); i += 2
+        elif a == "-radius":
+            radius = int(argv[i + 1]); i += 2
+        elif a == "-steps":
+            steps = int(argv[i + 1]); i += 2
+        elif a == "-optimized":
+            optimized = True; i += 1
+        else:
+            sys.stderr.write(f"unknown arg {a}\n"); return 2
+    if not stencil:
+        sys.stderr.write("usage: view_hlo -stencil <name> [-g N] "
+                         "[-radius N] [-steps K] [-optimized]\n")
+        return 2
+    view_hlo(stencil, g, radius, optimized, steps, out=sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
