@@ -1,0 +1,33 @@
+# Top-level build orchestration (counterpart of the reference's GNU-make
+# driver; the device "build" is XLA tracing at runtime, so make targets
+# cover the native library, tests, benches, and docs artifacts).
+
+PY ?= python
+TEST_ENV ?= PALLAS_AXON_POOL_IPS=
+
+.PHONY: all native test test-fast bench examples clean list-stencils
+
+all: native test
+
+native:
+	$(MAKE) -C yask_tpu/native
+
+test:
+	$(TEST_ENV) $(PY) -m pytest tests/ -q
+
+test-fast:
+	$(TEST_ENV) $(PY) -m pytest tests/ -q -x -k "not stencil_validates"
+
+bench:
+	$(PY) bench.py
+
+examples:
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) examples/swe_main.py
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) examples/wave_eq_main.py
+
+list-stencils:
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.compiler -list
+
+clean:
+	$(MAKE) -C yask_tpu/native clean
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -1,0 +1,47 @@
+"""Element-size / dtype coverage (reference real_bytes=4|8 builds +
+bf16 as the TPU-native half precision)."""
+
+import numpy as np
+import pytest
+
+from yask_tpu import yk_factory
+from yask_tpu.compiler.solution_base import create_solution
+
+
+@pytest.fixture(scope="module")
+def env():
+    return yk_factory().new_env()
+
+
+def run_heat(env, elem_bytes, g=12):
+    sb = create_solution("3axis", radius=1)
+    sb.get_soln().set_element_bytes(elem_bytes)
+    ctx = yk_factory().new_solution(env, sb)
+    ctx.apply_command_line_options(f"-g {g}")
+    ctx.prepare_solution()
+    ctx.get_var("A").set_elements_in_seq(0.1)
+    ctx.run_solution(0, 2)
+    return ctx.get_var("A").get_elements_in_slice(
+        [3, 0, 0, 0], [3, g - 1, g - 1, g - 1])
+
+
+def test_bf16(env):
+    import jax.numpy as jnp
+    a16 = run_heat(env, 2)
+    a32 = run_heat(env, 4)
+    assert a16.dtype == jnp.bfloat16
+    # bf16 has ~3 decimal digits; averaging stays close
+    np.testing.assert_allclose(a16.astype(np.float64),
+                               a32.astype(np.float64), rtol=0.05, atol=0.05)
+
+
+def test_fp32_default(env):
+    a = run_heat(env, 4)
+    assert a.dtype == np.float32
+
+
+def test_invalid_elem_bytes():
+    from yask_tpu.utils.exceptions import YaskException
+    sb = create_solution("3axis", radius=1)
+    with pytest.raises(YaskException):
+        sb.get_soln().set_element_bytes(3)

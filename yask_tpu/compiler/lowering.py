@@ -437,7 +437,10 @@ class StepProgram:
         return out
 
     def _eval(self, e: Expr, region, t, state, computed, scratch_vals, memo):
-        key = (id(e),)
+        # Structural memo key: common subexpressions are traced once per
+        # part even across equations (the reference's CSE pass,
+        # ExprUtils.hpp:77, done here as hash-consing at eval time).
+        key = e.skey()
         if key in memo:
             return memo[key]
         ops = self.ops
@@ -558,12 +561,15 @@ class StepProgram:
             return
 
         region = self._interior_region()
+        # One memo across the whole part: no eq in a part reads a var the
+        # part writes (parts have no internal deps), so cached reads stay
+        # valid and duplicated subtrees across equations trace once.
+        memo: Dict = {}
         for eq in part.eqs:
             name = eq.lhs.var_name()
             g = self.geoms[name]
             ring = state[name]
             base_arr = computed.get(name, ring[0])  # evicted slot is base
-            memo: Dict = {}
             val = self._eval(eq.rhs, region, t, state, computed,
                              scratch_vals, memo)
             val = self._to_var_layout(ops.asdtype(val, self.dtype), g, region)

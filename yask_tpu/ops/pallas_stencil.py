@@ -151,7 +151,7 @@ class _TileEval:
         return arr[tuple(idxs)]
 
     def eval(self, e: Expr, tiles, computed, region, memo):
-        k = id(e)
+        k = e.skey()   # structural: CSE across equations within a sub-step
         if k in memo:
             return memo[k]
         ev = lambda a: self.eval(a, tiles, computed, region, memo)

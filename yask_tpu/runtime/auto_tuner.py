@@ -50,9 +50,17 @@ class AutoTuner:
                       else ctx._opts.auto_tune_trial_secs)
         best_key, best_rate = None, None
         dirn = ctx._ana.step_dir
+        use_pallas = ctx._mode == "pallas"
         for k in cands:
             key = (k,)
-            compiled = ctx._get_compiled_chunk(k)
+            if use_pallas:
+                try:
+                    pfn = ctx._get_pallas_chunk(k)
+                except Exception:
+                    continue  # tile wouldn't fit VMEM etc.
+                compiled = lambda st, t, _f=pfn: _f(st)
+            else:
+                compiled = ctx._get_compiled_chunk(k)
             # warmup call (not timed — excludes dispatch jitter)
             st = compiled(ctx._state, ctx._cur_step)
             jax.block_until_ready(st)

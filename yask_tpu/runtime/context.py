@@ -482,20 +482,18 @@ class StencilContext:
             jax.block_until_ready(st)
         self._state = st
 
-    def _run_pallas_steps(self, start: int, n: int) -> None:
-        """Advance using the fused Pallas sweep: ⌊n/K⌋ fused chunks (K =
-        wf_steps temporal fusion) plus an XLA-path remainder."""
+    def _get_pallas_chunk(self, K: int):
+        """Compiled fused-Pallas chunk for K steps with the current block
+        settings (cached per (K, block) — the auto-tuner varies both)."""
         import jax
-        self._state_to_device()
-        K = min(max(self._opts.wf_steps, 1), n)
-        key = ("pallas", K)
+        bs = self._opts.block_sizes
+        blk = None
+        if any(bs[d] > 0 for d in self._ana.domain_dims[:-1]):
+            blk = tuple(bs[d] if bs[d] > 0 else 8
+                        for d in self._ana.domain_dims[:-1])
+        key = ("pallas", K, blk)
         if key not in self._jit_cache:
             from yask_tpu.ops.pallas_stencil import build_pallas_chunk
-            blk = None
-            bs = self._opts.block_sizes
-            if any(bs[d] > 0 for d in self._ana.domain_dims[:-1]):
-                blk = tuple(bs[d] if bs[d] > 0 else 8
-                            for d in self._ana.domain_dims[:-1])
             interp = self._env.get_platform() != "tpu"
             chunk, tile_bytes = build_pallas_chunk(
                 self._program, fuse_steps=K, block=blk, interpret=interp)
@@ -504,8 +502,17 @@ class StencilContext:
             self._jit_cache[key] = fn
             self._compile_secs += time.perf_counter() - t0c
             self._env.trace_msg(
-                f"pallas chunk: K={K}, tile {tile_bytes / 2**20:.2f} MiB")
-        fn = self._jit_cache[key]
+                f"pallas chunk: K={K}, blocks={blk or 'planner'}, "
+                f"tile {tile_bytes / 2**20:.2f} MiB")
+        return self._jit_cache[key]
+
+    def _run_pallas_steps(self, start: int, n: int) -> None:
+        """Advance using the fused Pallas sweep: ⌊n/K⌋ fused chunks (K =
+        wf_steps temporal fusion) plus an XLA-path remainder."""
+        import jax
+        self._state_to_device()
+        K = min(max(self._opts.wf_steps, 1), n)
+        fn = self._get_pallas_chunk(K)
         groups, rem = divmod(n, K)
         t = start
         dirn = self._ana.step_dir
