@@ -86,6 +86,54 @@ def test_shard_geometry_validation(env):
         ctx.prepare_solution()
 
 
+def test_overlap_vs_no_overlap(env):
+    a = make_ssg(env, "shard_map", ranks=[("x", 4)])
+    ctx = yk_factory().new_solution(env, stencil="ssg", radius=2)
+    ctx.apply_command_line_options("-g 24 -no-overlap_comms")
+    ctx.get_settings().mode = "shard_map"
+    ctx.set_num_ranks("x", 4)
+    assert ctx.get_settings().overlap_comms is False
+    ctx.prepare_solution()
+    rng = np.random.RandomState(7)
+    for name in ctx.get_var_names():
+        v = ctx.get_var(name)
+        if name == "rho":
+            v.set_all_elements_same(1.0)
+        elif name in ("lambda_", "mu"):
+            v.set_all_elements_same(0.01)
+        elif name.startswith("v_"):
+            arr = (rng.rand(24, 24, 24) * 0.1).astype(np.float32)
+            v.set_elements_in_slice(arr, [0, 0, 0, 0], [0, 23, 23, 23])
+    ctx.run_solution(0, 3)
+    assert ctx.compare_data(a) == 0
+
+
+def test_scratch_and_conditions_sharded(env):
+    """swe2d: scratch flux chains + IF_DOMAIN walls under shard_map with
+    overlap — the hardest combination the exchange planner faces."""
+    def run(mode, ranks=()):
+        ctx = yk_factory().new_solution(env, stencil="swe2d")
+        ctx.apply_command_line_options("-g 32")
+        ctx.get_settings().mode = mode
+        for d, nn in ranks:
+            ctx.set_num_ranks(d, nn)
+        ctx.prepare_solution()
+        h0 = np.ones((32, 32), dtype=np.float32)
+        h0[8:16, 8:16] = 2.0
+        ctx.get_var("h").set_elements_in_slice(h0, [0, 0, 0], [0, 31, 31])
+        ctx.get_var("hu").set_all_elements_same(0.0)
+        ctx.get_var("hv").set_all_elements_same(0.0)
+        ctx.get_var("lam").set_element(0.2, [])
+        ctx.get_var("grav").set_element(1.0, [])
+        ctx.run_solution(0, 3)
+        return ctx
+
+    ref = run("ref")
+    assert run("jit").compare_data(ref) == 0
+    assert run("shard_map", [("x", 4)]).compare_data(ref) == 0
+    assert run("shard_map", [("x", 2), ("y", 2)]).compare_data(ref) == 0
+
+
 def test_conditions_under_sharding(env):
     """Sub-domain conditions use global coordinates, so the conditional
     region must land identically however the domain is sharded."""

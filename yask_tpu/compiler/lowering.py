@@ -528,10 +528,16 @@ class StepProgram:
             val = val.transpose(perm)
         return val
 
-    def _eval_part(self, part: Part, t, state, computed, scratch_vals):
+    def _eval_part(self, part: Part, t, state, computed, scratch_vals,
+                   over: Optional[Dict[str, Tuple[int, int]]] = None):
+        """Evaluate a part; ``over`` restricts evaluation to a sub-region
+        of the interior (interior coords) — the basis of the
+        interior/exterior overlap split (reference ``MpiSection``,
+        ``context.hpp:789-833``)."""
         ops = self.ops
+        base_region = over if over is not None else self._interior_region()
         if part.is_scratch:
-            # Evaluate over domain expanded by the write-halo.
+            # Evaluate over the (sub-)region expanded by the write-halo.
             for eq in part.eqs:
                 g = self.geoms[eq.lhs.var_name()]
                 wh = self.ana.scratch_write_halo.get(g.name, {})
@@ -539,7 +545,8 @@ class StepProgram:
                 for d in self.ana.domain_dims:
                     wl, wr = wh.get(d, (0, 0))
                     if d in g.domain_dims:
-                        region[d] = (-wl, self.sizes[d] + wr)
+                        a, b = base_region[d]
+                        region[d] = (a - wl, b + wr)
                     else:
                         region[d] = (0, 1)  # scratch lacks this dim? rare
                 memo: Dict = {}
@@ -560,7 +567,7 @@ class StepProgram:
                 scratch_vals[g.name] = (val, origin)
             return
 
-        region = self._interior_region()
+        region = base_region
         # One memo across the whole part: no eq in a part reads a var the
         # part writes (parts have no internal deps), so cached reads stay
         # valid and duplicated subtrees across equations trace once.
@@ -574,15 +581,15 @@ class StepProgram:
                              scratch_vals, memo)
             val = self._to_var_layout(ops.asdtype(val, self.dtype), g, region)
 
-            # Interior index tuple in the var's own axis order.
+            # Written-region index tuple in the var's own axis order.
             idxs = []
             misc = eq.lhs.misc_vals()
             for n, kind in g.axes:
                 if kind == "misc":
                     idxs.append(misc[n] - g.misc_lo[n])
                 else:
-                    idxs.append(slice(g.origin[n],
-                                      g.origin[n] + self.sizes[n]))
+                    a, b = region[n]
+                    idxs.append(slice(g.origin[n] + a, g.origin[n] + b))
 
             cond_mask = None
             if eq.cond is not None:
@@ -600,10 +607,13 @@ class StepProgram:
 
             computed[name] = ops.update(base_arr, tuple(idxs), val)
 
-    def eval_stage(self, stage_idx: int, t, state, computed, scratch_vals):
-        """Evaluate one stage in place on (computed, scratch_vals)."""
+    def eval_stage(self, stage_idx: int, t, state, computed, scratch_vals,
+                   over: Optional[Dict[str, Tuple[int, int]]] = None):
+        """Evaluate one stage in place on (computed, scratch_vals);
+        ``over`` restricts to a sub-region (overlap split)."""
         for part in self.ana.stages[stage_idx].parts:
-            self._eval_part(part, t, state, computed, scratch_vals)
+            self._eval_part(part, t, state, computed, scratch_vals,
+                            over=over)
 
     def step(self, state, t, halo_hook: Optional[Callable] = None):
         """Advance the solution by one step; returns the new state.

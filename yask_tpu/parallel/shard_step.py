@@ -67,6 +67,113 @@ def exchange_ghosts(arr, geom, dim_widths: Dict[str, Tuple[int, int]],
     return arr
 
 
+def _make_overlap_step(prog, nr, lsizes):
+    """Interior/exterior-split step: the reference's compute/communication
+    overlap (``run_solution`` exterior-then-interior structure,
+    ``context.cpp:377-478``, ``MpiSection`` flags ``context.hpp:789-833``)
+    recast for XLA's scheduler.
+
+    Per stage: the *core* region (interior shrunk by the stage's ghost
+    widths in sharded dims) is evaluated against the **pre-exchange**
+    arrays — its data dependencies exclude the ppermutes, so XLA is free
+    to run the collectives concurrently with core compute. The boundary
+    *shell* slabs are then evaluated against the exchanged arrays.
+    Overlapping shell corners recompute identical values (idempotent).
+    """
+    ana = prog.ana
+    dims = ana.domain_dims
+    stage_writes = []
+    for stage in ana.stages:
+        ws = []
+        for part in stage.parts:
+            if not part.is_scratch:
+                for eq in part.eqs:
+                    if eq.lhs.var_name() not in ws:
+                        ws.append(eq.lhs.var_name())
+        stage_writes.append(ws)
+
+    def one_step(st, t):
+        computed: Dict[str, object] = {}
+        computed_post: Dict[str, object] = {}
+        state_post = dict(st)
+        exchanged = set()
+
+        for si in range(len(ana.stages)):
+            reads = prog.stage_reads[si]
+            # refresh ghosts (post versions) for this stage's inputs
+            for vname, widths in reads.items():
+                g = prog.geoms[vname]
+                if not any(nr.get(d, 1) > 1 for d in widths):
+                    continue
+                if vname in computed:
+                    if vname not in computed_post:
+                        computed_post[vname] = exchange_ghosts(
+                            computed[vname], g, widths, nr, lsizes)
+                elif g.is_written and g.has_step and vname not in exchanged:
+                    ring = list(state_post[vname])
+                    ring[-1] = exchange_ghosts(ring[-1], g, widths, nr,
+                                               lsizes)
+                    state_post[vname] = ring
+                    exchanged.add(vname)
+
+            # stage ghost widths in sharded dims
+            act: Dict[str, Tuple[int, int]] = {}
+            for vname, widths in reads.items():
+                for d, (l, r) in widths.items():
+                    if nr.get(d, 1) > 1:
+                        cl, cr = act.get(d, (0, 0))
+                        act[d] = (max(cl, l), max(cr, r))
+            splittable = act and all(
+                lsizes[d] - l - r > 0 for d, (l, r) in act.items())
+
+            post_env = {**computed, **computed_post}
+            if not splittable:
+                tmp = dict(post_env)
+                prog.eval_stage(si, t, state_post, tmp, {})
+                for name in stage_writes[si]:
+                    computed[name] = tmp[name]
+                    # an exchanged snapshot of an older value is now stale
+                    computed_post.pop(name, None)
+                continue
+
+            # core with PRE-exchange arrays
+            core = {d: (act.get(d, (0, 0))[0],
+                        lsizes[d] - act.get(d, (0, 0))[1]) for d in dims}
+            tmp_core = dict(computed)
+            prog.eval_stage(si, t, st, tmp_core, {}, over=core)
+
+            # shells with POST-exchange arrays, accumulating on core output
+            tmp = dict(post_env)
+            for name in stage_writes[si]:
+                tmp[name] = tmp_core[name]
+            interior = {d: (0, lsizes[d]) for d in dims}
+            for d, (l, r) in act.items():
+                for a, b in ((0, l), (lsizes[d] - r, lsizes[d])):
+                    if b <= a:
+                        continue
+                    over = dict(interior)
+                    over[d] = (a, b)
+                    prog.eval_stage(si, t, state_post, tmp, {}, over=over)
+            for name in stage_writes[si]:
+                computed[name] = tmp[name]
+                computed_post.pop(name, None)
+
+        # ring rotation (mirrors StepProgram.step), carrying exchanged rings
+        new_state: Dict[str, List] = {}
+        for name, ring in state_post.items():
+            g = prog.geoms[name]
+            if name in computed:
+                if g.has_step:
+                    new_state[name] = list(ring[1:]) + [computed[name]]
+                else:
+                    new_state[name] = [computed[name]]
+            else:
+                new_state[name] = list(ring)
+        return new_state
+
+    return one_step
+
+
 def run_shard_map(ctx, start: int, n: int) -> None:
     """Advance ``n`` steps in explicit shard_map mode, updating
     ``ctx._state`` (global padded arrays) in place."""
@@ -124,7 +231,8 @@ def run_shard_map(ctx, start: int, n: int) -> None:
                         pads.append(g.pads[dn])
                     else:
                         pads.append((0, 0))
-                state[k] = [jnp.pad(a, pads) for a in interior_state[k]]
+                state[k] = [jnp.pad(a, pads) if pads else a
+                            for a in interior_state[k]]
 
             # 2) pre-exchange every slot once so older ring slots carry
             #    valid ghosts (steady-state invariant: only the newest slot
@@ -140,7 +248,7 @@ def run_shard_map(ctx, start: int, n: int) -> None:
                         for a in state[k]]
 
             # 3) scan steps; before each stage refresh stale ghosts only.
-            def one_step(st, t):
+            def one_step_plain(st, t):
                 refreshed = set()
 
                 def hook(si, state_, computed):
@@ -162,6 +270,10 @@ def run_shard_map(ctx, start: int, n: int) -> None:
                     return state_, computed
 
                 return prog.step(st, t, halo_hook=hook)
+
+            one_step_ov = _make_overlap_step(prog, nr, lsizes)
+            one_step = one_step_ov if ctx._opts.overlap_comms \
+                else one_step_plain
 
             def scan_body(carry, _):
                 st, t = carry
