@@ -177,6 +177,49 @@ def print_dot(soln, lite: bool = True) -> str:
 
 
 # ---------------------------------------------------------------------------
+# POV-Ray printer
+# ---------------------------------------------------------------------------
+
+
+def print_povray(soln) -> str:
+    """3-D rendering of the stencil's read pattern as POV-Ray boxes
+    (reference ``POVRayPrinter``): one unit cube per distinct read offset
+    of the first equation's RHS, colored per var."""
+    from yask_tpu.compiler.expr import count_points
+    soln.analyze()
+    eqs = soln.get_equations()
+    out: List[str] = [
+        "#include \"colors.inc\"",
+        f"// stencil '{soln.get_name()}' read pattern",
+        "camera { location <12, 10, -16> look_at <0, 0, 0> }",
+        "light_source { <20, 30, -25> color White }",
+        "background { color White }",
+    ]
+    palette = ["Red", "Blue", "Green", "Orange", "Violet", "Cyan",
+               "Magenta", "Yellow"]
+    var_color: Dict[str, str] = {}
+    seen = set()
+    for eq in eqs:
+        for p in count_points(eq.rhs):
+            offs = p.domain_offsets()
+            dims = list(offs.keys())[:3]
+            coord = tuple(offs[d] for d in dims) + (0,) * (3 - len(dims))
+            key = (p.var_name(), coord)
+            if key in seen:
+                continue
+            seen.add(key)
+            color = var_color.setdefault(
+                p.var_name(), palette[len(var_color) % len(palette)])
+            x, y, z = coord
+            out.append(
+                f"box {{ <{x - 0.4}, {y - 0.4}, {z - 0.4}>, "
+                f"<{x + 0.4}, {y + 0.4}, {z + 0.4}> "
+                f"texture {{ pigment {{ color {color} }} }} }}"
+                f" // {p.var_name()}")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
 # Python-module printer (the TPU "codegen output")
 # ---------------------------------------------------------------------------
 

@@ -32,7 +32,8 @@ from yask_tpu.compiler.var import Var
 #: second group are debug text formats mirroring the reference's
 #: pseudo/dot printers (``Solution.cpp:241-259``).
 TPU_TARGETS = ("tpu", "jnp", "pallas")
-TEXT_TARGETS = ("pseudo", "pseudo-long", "dot", "dot-lite", "py-api")
+TEXT_TARGETS = ("pseudo", "pseudo-long", "dot", "dot-lite", "povray",
+                "py-api")
 ALL_TARGETS = TPU_TARGETS + TEXT_TARGETS
 
 
@@ -278,6 +279,8 @@ class yc_solution:
             text = printers.print_pseudo(self, long=target == "pseudo-long")
         elif target in ("dot", "dot-lite"):
             text = printers.print_dot(self, lite=target == "dot-lite")
+        elif target == "povray":
+            text = printers.print_povray(self)
         elif target == "py-api" or target in TPU_TARGETS:
             text = printers.print_py_module(self)
         else:  # pragma: no cover

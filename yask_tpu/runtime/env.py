@@ -86,5 +86,31 @@ class yk_env:
         if self._trace:
             self._debug.write(f"YASK-TPU: {msg}\n")
 
+    # ---- multi-host bootstrap (the MPI_Init analog across hosts) ---------
+
+    @staticmethod
+    def init_distributed(coordinator_address: str, num_processes: int,
+                         process_id: int) -> None:
+        """Join a multi-host JAX cluster (``jax.distributed``): after this,
+        ``jax.devices()`` spans every host and meshes ride ICI within a
+        slice / DCN across — the reference's multi-node MPI launch
+        (``setup.cpp:51-90``) without per-rank SPMD processes."""
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+
+    # ---- profiling (SURVEY §5: VTune/XProf analog) -----------------------
+
+    def start_profiler_trace(self, log_dir: str) -> None:
+        """Begin an XProf/TensorBoard trace (the reference's VTune
+        resume/pause hooks around trials, ``yask_main.cpp:33-44``)."""
+        import jax
+        jax.profiler.start_trace(log_dir)
+
+    def stop_profiler_trace(self) -> None:
+        import jax
+        jax.profiler.stop_trace()
+
     def finalize(self) -> None:
         """Counterpart of MPI_Finalize; nothing to tear down."""
