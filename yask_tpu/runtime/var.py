@@ -135,7 +135,11 @@ class yk_var:
         return slot
 
     def _split_indices(self, indices: Sequence[int]) -> Tuple[Optional[int], List]:
-        """Split full-index list (declared dim order) into (step, rest)."""
+        """Split full-index list (declared dim order) into (step, rest),
+        with strict bounds checking (the reference's ``check=1``
+        bounds-checked access builds, ``generic_var.hpp:70-97``: indices
+        must land inside the allocation — negative indices address the
+        left pad explicitly, they never wrap)."""
         v = self._var()
         dims = v.get_dims()
         if len(indices) != len(dims):
@@ -148,11 +152,19 @@ class yk_var:
         for d, i in zip(dims, indices):
             if d.type.value == "step":
                 t = int(i)
-            elif d.type.value == "domain":
-                rest.append(int(i) + g.origin[d.name]
-                            - self._ctx._rank_offset.get(d.name, 0))
+                continue
+            if d.type.value == "domain":
+                idx = (int(i) + g.origin[d.name]
+                       - self._ctx._rank_offset.get(d.name, 0))
             else:
-                rest.append(int(i) - g.misc_lo[d.name])
+                idx = int(i) - g.misc_lo[d.name]
+            size = g.shape[g.axis_of(d.name)]
+            if not (0 <= idx < size):
+                raise YaskException(
+                    f"index {d.name}={i} of var '{self._name}' outside "
+                    f"the allocation (padded extent {size}, left pad "
+                    f"{g.pads.get(d.name, (0, 0))[0] if d.type.value == 'domain' else 0})")
+            rest.append(idx)
         return t, rest
 
     # -- element access (yk_var_api.hpp:700-951) ---------------------------
