@@ -134,6 +134,44 @@ def test_scratch_and_conditions_sharded(env):
     assert run("shard_map", [("x", 2), ("y", 2)]).compare_data(ref) == 0
 
 
+def test_widening_ghost_widths_across_stages(env):
+    """Regression: a later stage reading the same computed var with WIDER
+    ghost offsets must re-exchange the union, not reuse the first stage's
+    narrow refresh."""
+    from yask_tpu.compiler.solution import yc_factory
+
+    def build():
+        soln = yc_factory().new_solution("widen")
+        t = soln.new_step_index("t")
+        x = soln.new_domain_index("x")
+        y = soln.new_domain_index("y")
+        a = soln.new_var("a", [t, x, y])
+        b = soln.new_var("b", [t, x, y])
+        c = soln.new_var("c", [t, x, y])
+        a(t + 1, x, y).EQUALS(a(t, x, y) * 0.9 + 0.1)
+        b(t + 1, x, y).EQUALS(a(t + 1, x - 1, y) + a(t + 1, x + 1, y))
+        c(t + 1, x, y).EQUALS(a(t + 1, x - 2, y) + a(t + 1, x + 2, y)
+                              + b(t + 1, x, y))
+        return soln
+
+    def run(mode, overlap=True):
+        ctx = yk_factory().new_solution(env, build())
+        ctx.apply_command_line_options("-g 32")
+        ctx.get_settings().mode = mode
+        ctx.get_settings().overlap_comms = overlap
+        if mode != "ref":
+            ctx.set_num_ranks("x", 4)
+        ctx.prepare_solution()
+        for n in ("a", "b", "c"):
+            ctx.get_var(n).set_elements_in_seq(0.1)
+        ctx.run_solution(0, 2)
+        return ctx
+
+    ref = run("ref")
+    assert run("shard_map", overlap=True).compare_data(ref) == 0
+    assert run("shard_map", overlap=False).compare_data(ref) == 0
+
+
 def test_conditions_under_sharding(env):
     """Sub-domain conditions use global coordinates, so the conditional
     region must land identically however the domain is sharded."""

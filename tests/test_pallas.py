@@ -83,6 +83,20 @@ def test_pallas_applicability_rules():
     assert not ok
 
 
+def test_pallas_rejects_fusion_beyond_planned_pad(env):
+    """Regression: a chunk with K bigger than the pads planned at prepare
+    time must be rejected, not silently clamp its halo DMA."""
+    from yask_tpu.ops.pallas_stencil import build_pallas_chunk
+    ctx = make(env, "pallas", wf=1)   # pads planned for K=1
+    with pytest.raises(YaskException):
+        build_pallas_chunk(ctx._program, fuse_steps=3, interpret=True)
+    # the auto-tuner therefore skips infeasible candidates instead of
+    # producing corrupt trials
+    ctx.get_var("A").set_elements_in_seq(0.1)
+    best = ctx.run_auto_tuner_now(candidates=[1, 3], min_trial_secs=0.02)
+    assert best == 1
+
+
 def test_pallas_mode_rejects_inapplicable(env):
     ctx = yk_factory().new_solution(env, stencil="ssg", radius=2)
     ctx.apply_command_line_options("-g 16")

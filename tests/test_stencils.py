@@ -23,22 +23,7 @@ def env():
     return yk_factory().new_env()
 
 
-def init_all_vars(ctx, seed=0.05):
-    """Deterministic nonzero init for every var (the harness'
-    ``-init_seed`` style init, yask_main.cpp:239-249). Coefficient-like
-    vars (never written) get values near 1 with small variation: safe as
-    divisors (1/ρ forms) and small enough as multipliers that deep fp32
-    expression trees don't blow into the cancellation regime."""
-    written = {eq.lhs.var_name() for eq in ctx._soln.get_equations()}
-    for i, name in enumerate(sorted(ctx.get_var_names())):
-        if name in written:
-            ctx.get_var(name).set_elements_in_seq(seed * (1 + i % 3))
-        else:
-            for slot in range(len(ctx._state[name])):
-                def fill(a):
-                    vals = 1.0 + 0.01 * (np.arange(a.size) % 13)
-                    return vals.reshape(a.shape).astype(a.dtype)
-                ctx._update_state_array(name, slot, fill)
+from yask_tpu.runtime.init_utils import init_solution_vars as init_all_vars
 
 
 def run_pair(env, name, **kwargs):

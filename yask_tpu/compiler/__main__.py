@@ -39,9 +39,10 @@ class CompilerCLISettings:
                             self, "stencil")
         p.add_int_option("radius", "Stencil radius (0 = default).",
                          self, "radius")
+        from yask_tpu.compiler.solution import ALL_TARGETS
         p.add_string_option(
-            "target", "Output target: tpu|jnp|pallas|pseudo|pseudo-long|"
-            "dot|dot-lite|py-api.", self, "target")
+            "target", "Output target: " + "|".join(ALL_TARGETS) + ".",
+            self, "target")
         p.add_string_option("p", "Output path ('-' = stdout).",
                             self, "path")
         p.add_int_option("elem-bytes", "FP element size (2|4|8).",

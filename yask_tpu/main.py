@@ -68,21 +68,7 @@ class HarnessSettings:
         p.add_bool_option("help", "Print help.", self, "help")
 
 
-def _init_vars(ctx, seed: float) -> None:
-    """Deterministic per-var init (the reference's ``-init_seed`` pattern,
-    ``yask_main.cpp:239-249``); read-only coefficient vars get near-1 values
-    so divisor forms stay well-conditioned."""
-    import numpy as np
-    written = {eq.lhs.var_name() for eq in ctx._soln.get_equations()}
-    for i, name in enumerate(sorted(ctx.get_var_names())):
-        if name in written:
-            ctx.get_var(name).set_elements_in_seq(seed * (1 + i % 3))
-        else:
-            for slot in range(len(ctx._state[name])):
-                def fill(a):
-                    vals = 1.0 + 0.01 * (np.arange(a.size) % 13)
-                    return vals.reshape(a.shape).astype(a.dtype)
-                ctx._update_state_array(name, slot, fill)
+from yask_tpu.runtime.init_utils import init_solution_vars as _init_vars
 
 
 def _build(opts: HarnessSettings, extra_args: List[str]):

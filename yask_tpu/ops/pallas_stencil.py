@@ -214,8 +214,20 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     hK = {d: rad[d] * K for d in lead}
 
     sizes = {d: program.sizes[d] for d in dims}
-    # minor dim: full padded extent lives in the tile
-    some_geom = next(iter(program.geoms.values()))
+
+    # Every var's leading-dim pads must cover the fused halo, or the DMA
+    # start/end would clamp silently and corrupt results: the runtime
+    # plans extra_pad = radius*K at prepare time, so a K larger than
+    # planned must be rejected here (the auto-tuner relies on this to
+    # skip infeasible candidates).
+    for n, g in program.geoms.items():
+        for d in lead:
+            pl_, pr_ = g.pads[d]
+            if pl_ < hK[d] or pr_ < hK[d]:
+                raise YaskException(
+                    f"pallas fuse_steps={K} needs pad >= {hK[d]} in dim "
+                    f"'{d}' but var '{n}' has ({pl_},{pr_}); re-prepare "
+                    "with wf_steps set to the desired fusion depth")
 
     # default block: from the tile planner (fold hints → VREG mapping)
     if block is None:

@@ -96,7 +96,21 @@ def _make_overlap_step(prog, nr, lsizes):
         computed: Dict[str, object] = {}
         computed_post: Dict[str, object] = {}
         state_post = dict(st)
-        exchanged = set()
+        # widths already exchanged per buffer — a later stage reading the
+        # same var with *wider* ghosts must re-exchange the union, not
+        # reuse the narrow refresh
+        ring_w: Dict[str, Dict[str, Tuple[int, int]]] = {}
+        post_w: Dict[str, Dict[str, Tuple[int, int]]] = {}
+
+        def widen(applied, widths):
+            out = dict(applied)
+            grew = False
+            for d, (l, r) in widths.items():
+                al, ar = out.get(d, (0, 0))
+                if l > al or r > ar:
+                    grew = True
+                out[d] = (max(al, l), max(ar, r))
+            return out, grew
 
         for si in range(len(ana.stages)):
             reads = prog.stage_reads[si]
@@ -106,15 +120,19 @@ def _make_overlap_step(prog, nr, lsizes):
                 if not any(nr.get(d, 1) > 1 for d in widths):
                     continue
                 if vname in computed:
-                    if vname not in computed_post:
+                    union, grew = widen(post_w.get(vname, {}), widths)
+                    if vname not in computed_post or grew:
                         computed_post[vname] = exchange_ghosts(
-                            computed[vname], g, widths, nr, lsizes)
-                elif g.is_written and g.has_step and vname not in exchanged:
-                    ring = list(state_post[vname])
-                    ring[-1] = exchange_ghosts(ring[-1], g, widths, nr,
-                                               lsizes)
-                    state_post[vname] = ring
-                    exchanged.add(vname)
+                            computed[vname], g, union, nr, lsizes)
+                        post_w[vname] = union
+                elif g.is_written and g.has_step:
+                    union, grew = widen(ring_w.get(vname, {}), widths)
+                    if vname not in ring_w or grew:
+                        ring = list(state_post[vname])
+                        ring[-1] = exchange_ghosts(ring[-1], g, union, nr,
+                                                   lsizes)
+                        state_post[vname] = ring
+                        ring_w[vname] = union
 
             # stage ghost widths in sharded dims
             act: Dict[str, Tuple[int, int]] = {}
@@ -134,6 +152,7 @@ def _make_overlap_step(prog, nr, lsizes):
                     computed[name] = tmp[name]
                     # an exchanged snapshot of an older value is now stale
                     computed_post.pop(name, None)
+                    post_w.pop(name, None)
                 continue
 
             # core with PRE-exchange arrays
@@ -157,6 +176,7 @@ def _make_overlap_step(prog, nr, lsizes):
             for name in stage_writes[si]:
                 computed[name] = tmp[name]
                 computed_post.pop(name, None)
+                post_w.pop(name, None)
 
         # ring rotation (mirrors StepProgram.step), carrying exchanged rings
         new_state: Dict[str, List] = {}
@@ -249,24 +269,40 @@ def run_shard_map(ctx, start: int, n: int) -> None:
 
             # 3) scan steps; before each stage refresh stale ghosts only.
             def one_step_plain(st, t):
-                refreshed = set()
+                # widths already applied per buffer: a later stage with
+                # wider ghost reads re-exchanges the union
+                applied = {}
+
+                def union_of(key, widths):
+                    out = dict(applied.get(key, {}))
+                    grew = key not in applied
+                    for d, (l, r) in widths.items():
+                        al, ar = out.get(d, (0, 0))
+                        if l > al or r > ar:
+                            grew = True
+                        out[d] = (max(al, l), max(ar, r))
+                    return out, grew
 
                 def hook(si, state_, computed):
                     reads = prog.stage_reads[si]
                     for vname, widths in reads.items():
                         g2 = prog.geoms[vname]
-                        if vname in computed and (vname, "c") not in refreshed:
-                            computed = {**computed, vname: exchange_ghosts(
-                                computed[vname], g2, widths, nr, lsizes)}
-                            refreshed.add((vname, "c"))
-                        elif vname not in computed and g2.is_written \
-                                and g2.has_step \
-                                and (vname, "s") not in refreshed:
-                            ring = list(state_[vname])
-                            ring[-1] = exchange_ghosts(
-                                ring[-1], g2, widths, nr, lsizes)
-                            state_ = {**state_, vname: ring}
-                            refreshed.add((vname, "s"))
+                        if vname in computed:
+                            u, grew = union_of((vname, "c"), widths)
+                            if grew:
+                                computed = {**computed,
+                                            vname: exchange_ghosts(
+                                                computed[vname], g2, u,
+                                                nr, lsizes)}
+                                applied[(vname, "c")] = u
+                        elif g2.is_written and g2.has_step:
+                            u, grew = union_of((vname, "s"), widths)
+                            if grew:
+                                ring = list(state_[vname])
+                                ring[-1] = exchange_ghosts(
+                                    ring[-1], g2, u, nr, lsizes)
+                                state_ = {**state_, vname: ring}
+                                applied[(vname, "s")] = u
                     return state_, computed
 
                 return prog.step(st, t, halo_hook=hook)

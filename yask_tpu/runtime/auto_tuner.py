@@ -86,8 +86,14 @@ class AutoTuner:
                 # early abandonment (the reference's cutoff,
                 # auto_tuner.cpp eval cutoff logic)
                 continue
-        ctx._opts.wf_steps = best_key[0]
         ctx._tuned = True
+        if best_key is None:
+            # every candidate infeasible (e.g. pallas tiles over the VMEM
+            # budget): keep current settings rather than crash the run
+            ctx._env.trace_msg("auto-tuner: no feasible candidates; "
+                               "keeping current settings")
+            return ctx._opts.wf_steps
+        ctx._opts.wf_steps = best_key[0]
         ctx._env.trace_msg(
             f"auto-tuner: wf_steps={best_key[0]} "
             f"({best_rate * 1e3:.3f} ms/step)")

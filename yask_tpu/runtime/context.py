@@ -382,13 +382,17 @@ class StencilContext:
         start, n = self._step_seq(first_step_index, last_step_index)
 
         # Trace mode: advance one step at a time, dumping written state
-        # after each (trace_mem analog; run_solution recursion keeps every
-        # execution path identical to the untraced one).
+        # after each (trace_mem analog). Hooks fire once for the whole
+        # span, exactly as untraced.
         if self._trace_dir and n > 1:
             t = start
-            for _ in range(n):
-                self.run_solution(t, t)
-                t += self._ana.step_dir
+            hooks, self._hooks = self._hooks, {k: [] for k in self._hooks}
+            try:
+                for _ in range(n):
+                    self.run_solution(t, t)
+                    t += self._ana.step_dir
+            finally:
+                self._hooks = hooks
             for h in self._hooks["after_run"]:
                 h(self)
             return

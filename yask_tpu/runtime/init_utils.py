@@ -1,0 +1,27 @@
+"""Deterministic var initialization for validation/benchmark runs.
+
+The single implementation of the harness' ``-init_seed`` pattern
+(reference ``yask_main.cpp:239-249``), shared by the harness CLI, the test
+suite's oracle sweeps, and the bitwise cross-backend checker so their
+conditioning never diverges: written (state) vars get a position-dependent
+sequence; read-only coefficient vars get values near 1 with small
+variation — safe as divisors (1/ρ forms) and mild as multipliers so deep
+fp32 expression trees stay out of the cancellation regime.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def init_solution_vars(ctx, seed: float = 0.05) -> None:
+    written = {eq.lhs.var_name() for eq in ctx._soln.get_equations()}
+    for i, name in enumerate(sorted(ctx.get_var_names())):
+        if name in written:
+            ctx.get_var(name).set_elements_in_seq(seed * (1 + i % 3))
+        else:
+            for slot in range(len(ctx._state[name])):
+                def fill(a):
+                    vals = 1.0 + 0.01 * (np.arange(a.size) % 13)
+                    return vals.reshape(a.shape).astype(a.dtype)
+                ctx._update_state_array(name, slot, fill)

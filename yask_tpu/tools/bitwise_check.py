@@ -32,16 +32,8 @@ def run_on(platform: str, stencil: str, radius, g: int, steps: int):
     ctx = fac.new_solution(env, stencil=stencil, radius=radius)
     ctx.apply_command_line_options(f"-g {g}")
     ctx.prepare_solution()
-    written = {eq.lhs.var_name() for eq in ctx._soln.get_equations()}
-    for i, name in enumerate(sorted(ctx.get_var_names())):
-        if name in written:
-            ctx.get_var(name).set_elements_in_seq(0.05 * (1 + i % 3))
-        else:
-            for slot in range(len(ctx._state[name])):
-                def fill(a):
-                    v = 1.0 + 0.01 * (np.arange(a.size) % 13)
-                    return v.reshape(a.shape).astype(a.dtype)
-                ctx._update_state_array(name, slot, fill)
+    from yask_tpu.runtime.init_utils import init_solution_vars
+    init_solution_vars(ctx)
     ctx.run_solution(0, steps - 1)
     return {name: np.asarray(ring[-1])
             for name, ring in ctx._state.items()}
