@@ -71,13 +71,33 @@ def test_pallas_block_sizes(env):
     assert p.compare_data(ref) == 0
 
 
+def test_pallas_multi_stage_ssg(env):
+    """Staggered elastic (velocity→stress same-step chain) on the fused
+    path: per-stage margin consumption must reproduce the XLA path."""
+    from yask_tpu.runtime.init_utils import init_solution_vars
+
+    def mk(mode, wf=1):
+        ctx = yk_factory().new_solution(env, stencil="ssg", radius=2)
+        ctx.apply_command_line_options("-g 24")
+        ctx.get_settings().mode = mode
+        ctx.get_settings().wf_steps = wf
+        ctx.prepare_solution()
+        init_solution_vars(ctx)
+        ctx.run_solution(0, 3)
+        return ctx
+
+    ref = mk("jit")
+    assert mk("pallas", wf=1).compare_data(ref) == 0
+    assert mk("pallas", wf=2).compare_data(ref) == 0
+
+
 def test_pallas_applicability_rules():
     assert pallas_applicable(
         create_solution("3axis", radius=1).get_soln().compile())[0]
-    # multi-stage (ssg) and condition-bearing (awp) solutions fall back
-    ok, why = pallas_applicable(
-        create_solution("ssg", radius=2).get_soln().compile())
-    assert not ok and "stage" in why
+    # multi-stage chains are supported now
+    assert pallas_applicable(
+        create_solution("ssg", radius=2).get_soln().compile())[0]
+    # condition-bearing solutions still fall back
     ok, why = pallas_applicable(
         create_solution("test_boundary_1d").get_soln().compile())
     assert not ok
@@ -98,7 +118,8 @@ def test_pallas_rejects_fusion_beyond_planned_pad(env):
 
 
 def test_pallas_mode_rejects_inapplicable(env):
-    ctx = yk_factory().new_solution(env, stencil="ssg", radius=2)
+    # awp has IF_DOMAIN conditions → not pallas-eligible
+    ctx = yk_factory().new_solution(env, stencil="awp")
     ctx.apply_command_line_options("-g 16")
     ctx.get_settings().mode = "pallas"
     with pytest.raises(YaskException):

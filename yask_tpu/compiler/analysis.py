@@ -431,6 +431,49 @@ class SolutionAnalysis:
 
     # ------------------------------------------------------------------
 
+    def stage_read_widths(self) -> List[Dict[str, Dict[str, Tuple[int, int]]]]:
+        """Per stage: vars (non-scratch) read with nonzero domain offsets
+        and the (left, right) ghost widths needed, with reads made by
+        scratch-writing equations widened by the scratch write-halo. Drives
+        both the distributed exchange planner and the Pallas per-stage
+        margin accounting."""
+        out: List[Dict[str, Dict[str, Tuple[int, int]]]] = []
+        for stage in self.stages:
+            reads: Dict[str, Dict[str, Tuple[int, int]]] = {}
+            for part in stage.parts:
+                for eq in part.eqs:
+                    lhs_wh = self.scratch_write_halo.get(
+                        eq.lhs.var_name(), {})
+                    for p in self._reads_of(eq):
+                        v = p.get_var()
+                        if v.is_scratch():
+                            continue
+                        entry = reads.setdefault(v.get_name(), {})
+                        for d, ofs in p.domain_offsets().items():
+                            wl, wr = lhs_wh.get(d, (0, 0))
+                            l, r = entry.get(d, (0, 0))
+                            entry[d] = (max(l, wl - min(ofs, 0)),
+                                        max(r, wr + max(ofs, 0)))
+            reads = {k: {d: lr for d, lr in vv.items() if lr != (0, 0)}
+                     for k, vv in reads.items()}
+            out.append({k: vv for k, vv in reads.items() if vv})
+        return out
+
+    def fused_step_radius(self) -> Dict[str, int]:
+        """Per domain dim, the (symmetric) margin ONE full step consumes
+        when fused in-tile: the sum over stages of each stage's max ghost
+        width (same-step chains eat margin stage by stage). Both the
+        Pallas kernel's shrink accounting and the runtime's pad planning
+        use exactly this number."""
+        out = {d: 0 for d in self.domain_dims}
+        for reads in self.stage_read_widths():
+            sm = {d: 0 for d in self.domain_dims}
+            for vv in reads.values():
+                for d, (l, r) in vv.items():
+                    sm[d] = max(sm[d], l, r)
+            out = {d: out[d] + sm[d] for d in self.domain_dims}
+        return out
+
     def max_halos(self) -> Dict[str, Tuple[int, int]]:
         """Per-domain-dim max (left, right) halo over all non-scratch vars —
         what the runtime uses for pad geometry and ghost-exchange width."""

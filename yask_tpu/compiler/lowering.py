@@ -293,34 +293,10 @@ class StepProgram:
             self.geoms[v.get_name()] = VarGeom(v, self.ana, sizes, extra_pad,
                                                pad_multiple)
 
-        # Stage metadata for halo exchange: vars (non-scratch) read by each
-        # stage with nonzero domain offsets → need fresh ghosts before it.
-        # Reads made by scratch-writing equations happen over the expanded
-        # (domain + write-halo) region, so their widths grow by the scratch
-        # LHS's write-halo (the dirty-width analog of
-        # find_scratch_write_halos, setup.cpp:1044).
-        self.stage_reads: List[Dict[str, Dict[str, Tuple[int, int]]]] = []
-        for stage in self.ana.stages:
-            reads: Dict[str, Dict[str, Tuple[int, int]]] = {}
-            for part in stage.parts:
-                for eq in part.eqs:
-                    lhs_wh = self.ana.scratch_write_halo.get(
-                        eq.lhs.var_name(), {})
-                    for p in self.ana._reads_of(eq):
-                        v = p.get_var()
-                        if v.is_scratch():
-                            continue
-                        entry = reads.setdefault(v.get_name(), {})
-                        for d, ofs in p.domain_offsets().items():
-                            wl, wr = lhs_wh.get(d, (0, 0))
-                            l, r = entry.get(d, (0, 0))
-                            entry[d] = (max(l, wl - min(ofs, 0)),
-                                        max(r, wr + max(ofs, 0)))
-            self.stage_reads.append(
-                {k: {d: lr for d, lr in vv.items() if lr != (0, 0)}
-                 for k, vv in reads.items()})
-        self.stage_reads = [
-            {k: vv for k, vv in sr.items() if vv} for sr in self.stage_reads]
+        # Stage metadata for halo exchange / fused-tile margin accounting
+        # (the dirty-width analog of the reference's per-var dirty flags,
+        # yk_var.hpp:564; see SolutionAnalysis.stage_read_widths).
+        self.stage_reads = self.ana.stage_read_widths()
 
     # -- state construction ------------------------------------------------
 

@@ -64,10 +64,10 @@ class _NodeScan(ExprVisitor):
 
 
 def pallas_applicable(csol) -> Tuple[bool, str]:
-    """Can this solution run on the Pallas fused path?"""
+    """Can this solution run on the Pallas fused path? Multi-stage chains
+    (ssg/fsg-class velocity→stress updates) are supported: each stage
+    consumes its read radius of tile margin within a fused sub-step."""
     ana = csol.ana
-    if len(ana.stages) != 1:
-        return False, "multiple stages"
     if len(ana.domain_dims) < 2:
         return False, "needs >= 2 domain dims"
     for eq in ana.eqs:
@@ -115,10 +115,11 @@ class _TileEval:
         name = p.var_name()
         so = p.step_offset()
         if name in computed and so is not None and so == self.step_dir:
+            # Same-step read of an earlier stage's output: computed values
+            # are kept as FULL tiles (written via .at[region].set on the
+            # evicted base), so offset slicing works exactly like rings.
             arr = computed[name]
-            computed_src = True
         else:
-            computed_src = False
             ring = tiles[name]
             if so is None or not p.get_var().is_written:
                 arr = ring[-1]
@@ -130,24 +131,10 @@ class _TileEval:
         for di, (d, (lo, hi)) in enumerate(zip(self.dims, region)):
             o = offs.get(d, 0)
             if di == len(self.dims) - 1:
-                if computed_src:
-                    # computed values are region-shaped; same-step reads
-                    # must be offset-free in the single-stage pallas class
-                    if o != 0:
-                        raise YaskException(
-                            "pallas path: same-step read with offset")
-                    idxs.append(slice(None))
-                else:
-                    base = self.minor_origin[name]
-                    idxs.append(slice(base + lo + o, base + hi + o))
+                base = self.minor_origin[name]
+                idxs.append(slice(base + lo + o, base + hi + o))
             else:
-                if computed_src:
-                    if o != 0:
-                        raise YaskException(
-                            "pallas path: same-step read with offset")
-                    idxs.append(slice(None))
-                else:
-                    idxs.append(slice(lo + o, hi + o))
+                idxs.append(slice(lo + o, hi + o))
         return arr[tuple(idxs)]
 
     def eval(self, e: Expr, tiles, computed, region, memo):
@@ -208,9 +195,23 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     lead = dims[:-1]
     minor = dims[-1]
 
-    # per-dim stencil radius (max halo over vars)
-    halos = ana.max_halos()
-    rad = {d: max(halos.get(d, (0, 0))) for d in dims}
+    # Per-stage, per-leading-dim read radius: within one fused sub-step a
+    # stage consumes its radius of tile margin (same-step chains eat
+    # margin stage by stage — the trapezoid accounting of the reference's
+    # temporal blocking, setup.cpp:863).
+    nstages = len(ana.stages)
+    stage_r: List[Dict[str, int]] = []
+    for si in range(nstages):
+        sr = {d: 0 for d in lead}
+        for vname, widths in program.stage_reads[si].items():
+            for d, (l, r) in widths.items():
+                if d in sr:
+                    sr[d] = max(sr[d], l, r)
+        stage_r.append(sr)
+    # full-step shrink per dim = sum over stages; fused halo = K x that
+    # (identical by construction to ana.fused_step_radius, which the
+    # runtime uses to plan pads)
+    rad = {d: ana.fused_step_radius().get(d, 0) for d in lead}
     hK = {d: rad[d] * K for d in lead}
 
     sizes = {d: program.sizes[d] for d in dims}
@@ -277,8 +278,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     minor_origin = {n: program.geoms[n].pads[minor][0] for n in var_order}
     ev = _TileEval(jnp, dims, ana.step_dir, minor_origin)
 
-    stage = ana.stages[0]
-    eqs = [eq for part in stage.parts for eq in part.eqs]
+    stage_eqs = [[eq for part in st.parts for eq in part.eqs]
+                 for st in ana.stages]
 
     n_inputs = sum(slots[n] for n in var_order)
 
@@ -322,52 +323,57 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 tiles[n].append(scratch[si][...])
                 si += 1
 
-        # 2) K fused sub-steps with shrinking compute regions + domain mask.
-        g0 = {n: program.geoms[n] for n in var_order}
+        # 2) K fused sub-steps; within each, every stage consumes its read
+        #    radius of tile margin (trapezoid shrink) and writes a FULL
+        #    tile (base.at[region].set) so later stages read it at offsets.
+        def region_idxs(name, region):
+            mo = program.geoms[name].pads[minor][0]
+            return tuple(slice(lo, hi) for lo, hi in region[:-1]) \
+                + (slice(mo + region[-1][0], mo + region[-1][1]),)
+
         for k in range(K):
-            # compute region in tile coords (leading dims)
-            region = []
-            for d in lead:
-                lo = rad[d] * (k + 1)
-                hi = block[d] + 2 * hK[d] - rad[d] * (k + 1)
-                region.append((lo, hi))
-            # minor: interior-relative coords (per-var pad origin applied
-            # at read/write time); pads stay zero
-            region.append((0, sizes[minor]))
-
-            # global-domain mask over the region's leading dims
-            mask = None
-            for di, d in enumerate(lead):
-                lo, hi = region[di]
-                gidx = (jnp.arange(lo, hi)
-                        + pid[di] * block[d] - hK[d])
-                m = (gidx >= 0) & (gidx < sizes[d])
-                shape = [1] * len(dims)
-                shape[di] = hi - lo
-                m = m.reshape(shape)
-                mask = m if mask is None else mask & m
-
             computed: Dict[str, object] = {}
-            memo: Dict = {}
-            for eq in eqs:
-                name = eq.lhs.var_name()
-                val = ev.eval(eq.rhs, tiles, computed, region, memo)
-                val = jnp.asarray(val, dtype=dtype)
-                val = jnp.broadcast_to(
-                    val, tuple(hi - lo for lo, hi in region))
-                if mask is not None:
-                    val = jnp.where(mask, val, jnp.zeros_like(val))
-                computed[name] = val
+            consumed = {d: rad[d] * k for d in lead}
+            for si_stage in range(nstages):
+                for d in lead:
+                    consumed[d] += stage_r[si_stage][d]
+                region = []
+                for d in lead:
+                    region.append((consumed[d],
+                                   block[d] + 2 * hK[d] - consumed[d]))
+                # minor: interior-relative (per-var pad origin applied at
+                # read/write time); pads stay zero
+                region.append((0, sizes[minor]))
 
-            # write back into tiles (rotate rings)
+                # global-domain mask over the region's leading dims
+                mask = None
+                for di, d in enumerate(lead):
+                    lo, hi = region[di]
+                    gidx = (jnp.arange(lo, hi)
+                            + pid[di] * block[d] - hK[d])
+                    m = (gidx >= 0) & (gidx < sizes[d])
+                    shape = [1] * len(dims)
+                    shape[di] = hi - lo
+                    m = m.reshape(shape)
+                    mask = m if mask is None else mask & m
+
+                memo: Dict = {}
+                for eq in stage_eqs[si_stage]:
+                    name = eq.lhs.var_name()
+                    val = ev.eval(eq.rhs, tiles, computed, region, memo)
+                    val = jnp.asarray(val, dtype=dtype)
+                    val = jnp.broadcast_to(
+                        val, tuple(hi - lo for lo, hi in region))
+                    if mask is not None:
+                        val = jnp.where(mask, val, jnp.zeros_like(val))
+                    base = computed.get(name, tiles[name][0])
+                    computed[name] = base.at[region_idxs(name, region)] \
+                        .set(val)
+
+            # rotate rings with the sub-step's outputs
             for name in written:
                 ring = tiles[name]
-                base = ring[0]
-                mo = program.geoms[name].pads[minor][0]
-                idxs = tuple(
-                    slice(lo, hi) for lo, hi in region[:-1]
-                ) + (slice(mo + region[-1][0], mo + region[-1][1]),)
-                newest = base.at[idxs].set(computed[name])
+                newest = computed[name]
                 if slots[name] >= 2:
                     tiles[name] = ring[1:] + [newest]
                 else:

@@ -260,9 +260,9 @@ class StencilContext:
                     f"solution '{self.get_name()}' cannot use the pallas "
                     f"path: {why}; use -mode jit")
             K = max(self._opts.wf_steps, 1)
-            halos = self._ana.max_halos()
+            step_rad = self._ana.fused_step_radius()
             for d in self._ana.domain_dims[:-1]:
-                need = max(halos.get(d, (0, 0))) * K
+                need = step_rad.get(d, 0) * K
                 l, r = extra[d]
                 extra[d] = (max(l, need), max(r, need))
         self._plan_kwargs = dict(extra_pad=extra, pad_multiple=pad_mult)

@@ -20,8 +20,25 @@ def init_solution_vars(ctx, seed: float = 0.05) -> None:
         if name in written:
             ctx.get_var(name).set_elements_in_seq(seed * (1 + i % 3))
         else:
+            g = ctx._program.geoms[name]
             for slot in range(len(ctx._state[name])):
                 def fill(a):
-                    vals = 1.0 + 0.01 * (np.arange(a.size) % 13)
-                    return vals.reshape(a.shape).astype(a.dtype)
+                    # interior-coordinate based, like set_elements_in_seq:
+                    # identical values whatever the pad geometry
+                    idxs, ishape = [], []
+                    for ax, (dn, kind) in enumerate(g.axes):
+                        if kind == "domain":
+                            size = ctx._opts.global_domain_sizes[dn]
+                            idxs.append(slice(g.origin[dn],
+                                              g.origin[dn] + size))
+                            ishape.append(size)
+                        else:
+                            idxs.append(slice(None))
+                            ishape.append(a.shape[ax])
+                    n = int(np.prod(ishape)) if ishape else 1
+                    vals = 1.0 + 0.01 * (np.arange(n) % 13)
+                    out = np.zeros_like(a)
+                    out[tuple(idxs)] = vals.reshape(ishape).astype(a.dtype) \
+                        if ishape else vals.astype(a.dtype)[0]
+                    return out
                 ctx._update_state_array(name, slot, fill)

@@ -231,16 +231,32 @@ class yk_var:
         self._dirty = True
 
     def set_elements_in_seq(self, seed: float = 0.1) -> None:
-        """Fill with a deterministic position-dependent sequence (the
-        harness' ``-init_seed`` pattern for validation runs,
-        ``yask_main.cpp:239-249``)."""
+        """Fill the interior with a deterministic position-dependent
+        sequence (the harness' ``-init_seed`` pattern, ``yask_main.cpp:
+        239-249``). Values depend only on interior coordinates — never on
+        pad geometry — so differently-padded contexts (jit vs pallas vs
+        sharded) start from identical state."""
+        g = self._geom()
         for slot in range(len(self._ring())):
             def fill(a, s=slot):
                 a = np.asarray(a)
-                n = a.size
+                idxs = []
+                ishape = []
+                for dn, kind in g.axes:
+                    if kind == "domain":
+                        size = self._ctx._opts.global_domain_sizes[dn]
+                        idxs.append(slice(g.origin[dn], g.origin[dn] + size))
+                        ishape.append(size)
+                    else:
+                        idxs.append(slice(None))
+                        ishape.append(a.shape[len(idxs) - 1])
+                n = int(np.prod(ishape)) if ishape else 1
                 vals = (np.arange(n, dtype=np.float64) % 17 + 1.0) \
                     * seed * (s + 1)
-                return vals.reshape(a.shape).astype(a.dtype)
+                out = np.zeros_like(a)
+                out[tuple(idxs)] = vals.reshape(ishape).astype(a.dtype) \
+                    if ishape else vals.astype(a.dtype)[0]
+                return out
             self._ctx._update_state_array(self._name, slot, fill)
         self._dirty = True
 
