@@ -91,16 +91,46 @@ def test_pallas_multi_stage_ssg(env):
     assert mk("pallas", wf=2).compare_data(ref) == 0
 
 
+@pytest.mark.parametrize("name,radius", [
+    ("iso3dfd_sponge", 2),   # partial-dim (1-D) coefficient vars
+    ("awp", None),           # 4 stages, IF_DOMAIN conditions, 0-dim var
+    ("test_partial_3d", None),  # reordered partial-dim var (cyz(z,y))
+    ("test_step_cond_1d", None),  # IF_STEP — 1-D, expect fallback error
+])
+def test_pallas_condition_and_partial_class(env, name, radius):
+    from yask_tpu.runtime.init_utils import init_solution_vars
+
+    def mk(mode, wf=1):
+        ctx = yk_factory().new_solution(env, stencil=name, radius=radius)
+        ctx.apply_command_line_options("-g 20")
+        ctx.get_settings().mode = mode
+        ctx.get_settings().wf_steps = wf
+        ctx.prepare_solution()
+        init_solution_vars(ctx)
+        ctx.run_solution(0, 3)
+        return ctx
+
+    if name == "test_step_cond_1d":
+        with pytest.raises(YaskException):
+            mk("pallas")
+        return
+    ref = mk("jit")
+    assert mk("pallas", wf=1).compare_data(ref) == 0
+    assert mk("pallas", wf=2).compare_data(ref) == 0
+
+
 def test_pallas_applicability_rules():
     assert pallas_applicable(
         create_solution("3axis", radius=1).get_soln().compile())[0]
-    # multi-stage chains are supported now
+    # multi-stage chains and condition-bearing solutions are supported
     assert pallas_applicable(
         create_solution("ssg", radius=2).get_soln().compile())[0]
-    # condition-bearing solutions still fall back
+    assert pallas_applicable(
+        create_solution("awp").get_soln().compile())[0]
+    # scratch-var solutions still fall back
     ok, why = pallas_applicable(
-        create_solution("test_boundary_1d").get_soln().compile())
-    assert not ok
+        create_solution("swe2d").get_soln().compile())
+    assert not ok and "scratch" in why
 
 
 def test_pallas_rejects_fusion_beyond_planned_pad(env):
@@ -118,8 +148,9 @@ def test_pallas_rejects_fusion_beyond_planned_pad(env):
 
 
 def test_pallas_mode_rejects_inapplicable(env):
-    # awp has IF_DOMAIN conditions → not pallas-eligible
-    ctx = yk_factory().new_solution(env, stencil="awp")
+    # swe2d uses scratch vars → not pallas-eligible (falls back with a
+    # named reason)
+    ctx = yk_factory().new_solution(env, stencil="swe2d")
     ctx.apply_command_line_options("-g 16")
     ctx.get_settings().mode = "pallas"
     with pytest.raises(YaskException):

@@ -33,10 +33,11 @@ from typing import Dict, List, Optional, Tuple
 from yask_tpu.utils.exceptions import YaskException
 from yask_tpu.compiler.expr import (
     AddExpr,
+    AndExpr,
+    CompExpr,
     ConstExpr,
     DivExpr,
     Expr,
-    ExprVisitor,
     FirstIndexExpr,
     FuncExpr,
     IndexExpr,
@@ -44,48 +45,34 @@ from yask_tpu.compiler.expr import (
     ModExpr,
     MultExpr,
     NegExpr,
+    NotExpr,
+    OrExpr,
     SubExpr,
     VarPoint,
 )
 
 
-class _NodeScan(ExprVisitor):
-    def __init__(self):
-        self.has_index_values = False
-
-    def visit_index(self, node):
-        self.has_index_values = True
-
-    def visit_first_index(self, node):
-        self.has_index_values = True
-
-    def visit_last_index(self, node):
-        self.has_index_values = True
-
-
 def pallas_applicable(csol) -> Tuple[bool, str]:
-    """Can this solution run on the Pallas fused path? Multi-stage chains
-    (ssg/fsg-class velocity→stress updates) are supported: each stage
-    consumes its read radius of tile margin within a fused sub-step."""
+    """Can this solution run on the Pallas fused path? Supported: multi-
+    stage chains (ssg/fsg-class), sub-domain/step conditions (awp-class —
+    lowered to in-tile masks over global coordinates), index-value
+    expressions, and partial-dim read-only coefficient vars (sponge
+    factors). Excluded: scratch vars, misc dims, partial-dim *written*
+    vars, ring allocation > 2."""
     ana = csol.ana
     if len(ana.domain_dims) < 2:
         return False, "needs >= 2 domain dims"
-    for eq in ana.eqs:
-        if eq.cond is not None or eq.step_cond is not None:
-            return False, "has conditions"
-        scan = _NodeScan()
-        eq.rhs.accept(scan)
-        if scan.has_index_values:
-            return False, "uses index values"
     for v in csol.soln.get_vars():
         if v.is_scratch():
             return False, "has scratch vars"
         if v.misc_dim_names():
             return False, "has misc dims"
-        if v.domain_dim_names() != ana.domain_dims:
-            return False, f"var '{v.get_name()}' spans a dim subset"
-        if v.is_written and v.get_step_alloc_size() > 2:
-            return False, "ring allocation > 2"
+        if v.is_written:
+            if v.domain_dim_names() != ana.domain_dims:
+                return False, (f"written var '{v.get_name()}' must span "
+                               "all domain dims")
+            if v.get_step_alloc_size() > 2:
+                return False, "ring allocation > 2"
     return True, "ok"
 
 
@@ -93,27 +80,49 @@ def pallas_applicable(csol) -> Tuple[bool, str]:
 
 
 class _TileEval:
-    """Evaluate the (restricted) stencil AST on VMEM tile values.
+    """Evaluate the stencil AST on VMEM tile values.
 
     ``tiles[name]`` is the ring of tile arrays (oldest→newest); a read at
-    offset ``o`` over compute-region ``lo..hi`` (tile coords, leading dims)
-    slices ``[lo+o : hi+o]``; the minor dim slices with its own origin.
+    offset ``o`` over compute-region ``lo..hi`` (tile coords, leading
+    dims; interior-relative for the minor dim) slices ``[lo+o : hi+o]``
+    with the var's own origins. Partial-dim read-only vars broadcast into
+    the region; index expressions produce *global* coordinate arrays so
+    conditions behave identically to the XLA path.
     """
 
-    def __init__(self, jnp, dims: List[str], step_dir: int,
+    def __init__(self, jnp, program, minor: str,
                  minor_origin: Dict[str, int]):
         self.jnp = jnp
-        self.dims = dims
-        self.step_dir = step_dir
-        # per-var pad-left of the minor dim (tiles share leading-dim
-        # geometry, but each var's minor extent is its own padded axis)
+        self.program = program
+        self.dims = program.ana.domain_dims
+        self.minor = minor
+        self.step_dir = program.ana.step_dir
         self.minor_origin = minor_origin
         from yask_tpu.compiler.lowering import JnpOps
         self.ops = JnpOps()
+        # set per-(stage, sub-step) by the kernel before evaluation:
+        self.region = None          # [(lo,hi)] per solution dim
+        self.gidx_base = None       # per lead dim: traced global offset of
+        #                             tile position 0 (pid*block - hK)
+        self.t = None               # step-index value (traced or None)
 
-    def read(self, p: VarPoint, tiles, computed, region):
+    def global_index(self, d: str):
+        """Global coordinate array for dim d over the current region,
+        broadcast-shaped."""
+        di = self.dims.index(d)
+        lo, hi = self.region[di]
+        ar = self.jnp.arange(lo, hi, dtype=self.jnp.int32)
+        if d != self.minor:
+            ar = ar + self.gidx_base[d]
+        shape = [1] * len(self.dims)
+        shape[di] = hi - lo
+        return ar.reshape(shape)
+
+    def read(self, p: VarPoint, tiles, computed):
         name = p.var_name()
+        g = self.program.geoms[name]
         so = p.step_offset()
+        region = self.region
         if name in computed and so is not None and so == self.step_dir:
             # Same-step read of an earlier stage's output: computed values
             # are kept as FULL tiles (written via .at[region].set on the
@@ -121,31 +130,63 @@ class _TileEval:
             arr = computed[name]
         else:
             ring = tiles[name]
-            if so is None or not p.get_var().is_written:
+            if so is None or not g.is_written:
                 arr = ring[-1]
             else:
                 idx = len(ring) - 1 + so * self.step_dir
                 arr = ring[idx]
         offs = p.domain_offsets()
         idxs = []
-        for di, (d, (lo, hi)) in enumerate(zip(self.dims, region)):
-            o = offs.get(d, 0)
-            if di == len(self.dims) - 1:
+        for dn, kind in g.axes:   # var's own axis order
+            di = self.dims.index(dn)
+            lo, hi = region[di]
+            o = offs.get(dn, 0)
+            if dn == self.minor:
                 base = self.minor_origin[name]
                 idxs.append(slice(base + lo + o, base + hi + o))
             else:
                 idxs.append(slice(lo + o, hi + o))
-        return arr[tuple(idxs)]
+        out = arr[tuple(idxs)]
 
-    def eval(self, e: Expr, tiles, computed, region, memo):
+        var_dd = g.domain_dims
+        if var_dd != self.dims:
+            # partial-dim (or reordered) var: transpose into solution
+            # order, insert singleton axes, broadcast over the region
+            present = [d for d in self.dims if d in var_dd]
+            perm = [var_dd.index(d) for d in present]
+            if perm != list(range(len(perm))):
+                out = out.transpose(perm)
+            shape = []
+            for d in self.dims:
+                di = self.dims.index(d)
+                lo, hi = region[di]
+                shape.append(hi - lo if d in var_dd else 1)
+            out = out.reshape(tuple(shape))
+            tgt = tuple(hi - lo for lo, hi in region)
+            out = self.jnp.broadcast_to(out, tgt)
+        return out
+
+    def eval(self, e: Expr, tiles, computed, memo):
         k = e.skey()   # structural: CSE across equations within a sub-step
         if k in memo:
             return memo[k]
-        ev = lambda a: self.eval(a, tiles, computed, region, memo)
+        jnp = self.jnp
+        ev = lambda a: self.eval(a, tiles, computed, memo)
         if isinstance(e, ConstExpr):
             r = e.value
         elif isinstance(e, VarPoint):
-            r = self.read(e, tiles, computed, region)
+            r = self.read(e, tiles, computed)
+        elif isinstance(e, IndexExpr):
+            if e.type.value == "step":
+                r = self.t
+            elif e.type.value == "domain":
+                r = self.global_index(e.name)
+            else:  # pragma: no cover - excluded by pallas_applicable
+                raise YaskException("misc index as value on pallas path")
+        elif isinstance(e, FirstIndexExpr):
+            r = 0
+        elif isinstance(e, LastIndexExpr):
+            r = self.program.global_last[e.dim.name]
         elif isinstance(e, NegExpr):
             r = -ev(e.arg)
         elif isinstance(e, AddExpr):
@@ -164,6 +205,17 @@ class _TileEval:
             r = ev(e.lhs) % ev(e.rhs)
         elif isinstance(e, FuncExpr):
             r = self.ops.func(e.name, [ev(a) for a in e.args])
+        elif isinstance(e, CompExpr):
+            a, b = ev(e.lhs), ev(e.rhs)
+            r = {"==": lambda: a == b, "!=": lambda: a != b,
+                 "<": lambda: a < b, "<=": lambda: a <= b,
+                 ">": lambda: a > b, ">=": lambda: a >= b}[e.op]()
+        elif isinstance(e, AndExpr):
+            r = jnp.logical_and(ev(e.lhs), ev(e.rhs))
+        elif isinstance(e, OrExpr):
+            r = jnp.logical_or(ev(e.lhs), ev(e.rhs))
+        elif isinstance(e, NotExpr):
+            r = jnp.logical_not(ev(e.arg))
         else:  # pragma: no cover - excluded by pallas_applicable
             raise YaskException(f"pallas path cannot evaluate {type(e)}")
         memo[k] = r
@@ -223,6 +275,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # skip infeasible candidates).
     for n, g in program.geoms.items():
         for d in lead:
+            if d not in g.domain_dims:
+                continue  # partial-dim var lacks this axis
             pl_, pr_ = g.pads[d]
             if pl_ < hK[d] or pr_ < hK[d]:
                 raise YaskException(
@@ -247,15 +301,18 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     var_order = sorted(program.geoms)
     written = [n for n in var_order if program.geoms[n].is_written]
 
-    # tile geometry per var: leading dims sized block+2hK, minor full padded
+    # tile geometry per var (its own axes): leading dims it has are sized
+    # block+2hK; the minor dim (if present) is its full padded extent
     def tile_shape(name):
         g = program.geoms[name]
         shp = []
-        for d in lead:
-            shp.append(block[d] + 2 * hK[d])
-        pl_, pr_ = g.pads[minor]
-        shp.append(sizes[minor] + pl_ + pr_)
-        return tuple(shp)
+        for dn, kind in g.axes:
+            if dn == minor:
+                pl_, pr_ = g.pads[minor]
+                shp.append(sizes[minor] + pl_ + pr_)
+            else:
+                shp.append(block[dn] + 2 * hK[dn])
+        return tuple(shp) if shp else (1,)  # 0-dim vars ride as (1,)
 
     dtype = program.dtype
     esize = jnp.dtype(dtype).itemsize
@@ -275,18 +332,25 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             f"(budget {vmem_budget/2**20:.0f}); shrink block or fuse_steps")
 
     grid = tuple(sizes[d] // block[d] for d in lead)
-    minor_origin = {n: program.geoms[n].pads[minor][0] for n in var_order}
-    ev = _TileEval(jnp, dims, ana.step_dir, minor_origin)
+    minor_origin = {n: (program.geoms[n].pads[minor][0]
+                        if minor in program.geoms[n].domain_dims else 0)
+                    for n in var_order}
+    ev = _TileEval(jnp, program, minor, minor_origin)
 
     stage_eqs = [[eq for part in st.parts for eq in part.eqs]
                  for st in ana.stages]
 
-    n_inputs = sum(slots[n] for n in var_order)
+    #: does any equation reference the step index (t-as-value / IF_STEP)?
+    needs_t = any(eq.step_cond is not None for eq in ana.eqs)
+    dirn = ana.step_dir
+
+    n_inputs = sum(slots[n] for n in var_order) + 1  # +1: t0 scalar
 
     def kernel(*refs):
-        # refs: inputs (ANY/HBM) ..., outputs (VMEM blocks) ...,
+        # refs: t0 (SMEM), inputs (ANY/HBM) ..., outputs (VMEM blocks),
         #       scratch tiles ..., sem
-        ins = refs[:n_inputs]
+        t0_ref = refs[0]
+        ins = refs[1:n_inputs]
         nout = sum(min(slots[n], 2) for n in written)
         outs = refs[n_inputs:n_inputs + nout]
         scratch = refs[n_inputs + nout:-1]
@@ -302,12 +366,17 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             for s in range(slots[n]):
                 src = ins[si]
                 idxs = []
-                for di, d in enumerate(lead):
-                    start = pid[di] * block[d] + g.origin[d] - hK[d]
-                    idxs.append(pl.ds(start, block[d] + 2 * hK[d]))
-                idxs.append(slice(None))  # minor dim: full extent
+                for dn, kind in g.axes:
+                    if dn == minor:
+                        idxs.append(slice(None))  # full padded extent
+                    else:
+                        di = lead.index(dn)
+                        start = (pid[di] * block[dn]
+                                 + g.origin[dn] - hK[dn])
+                        idxs.append(pl.ds(start, block[dn] + 2 * hK[dn]))
                 dma = pltpu.make_async_copy(
-                    src.at[tuple(idxs)], scratch[si], sem.at[si])
+                    src.at[tuple(idxs)] if idxs else src,
+                    scratch[si], sem.at[si])
                 dma.start()
                 dmas.append(dma)
                 si += 1
@@ -331,9 +400,12 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             return tuple(slice(lo, hi) for lo, hi in region[:-1]) \
                 + (slice(mo + region[-1][0], mo + region[-1][1]),)
 
+        ev.gidx_base = {d: pid[lead.index(d)] * block[d] - hK[d]
+                        for d in lead}
         for k in range(K):
             computed: Dict[str, object] = {}
             consumed = {d: rad[d] * k for d in lead}
+            ev.t = t0_ref[0] + k * dirn
             for si_stage in range(nstages):
                 for d in lead:
                     consumed[d] += stage_r[si_stage][d]
@@ -344,6 +416,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 # minor: interior-relative (per-var pad origin applied at
                 # read/write time); pads stay zero
                 region.append((0, sizes[minor]))
+                ev.region = region
+                rshape = tuple(hi - lo for lo, hi in region)
 
                 # global-domain mask over the region's leading dims
                 mask = None
@@ -360,13 +434,25 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 memo: Dict = {}
                 for eq in stage_eqs[si_stage]:
                     name = eq.lhs.var_name()
-                    val = ev.eval(eq.rhs, tiles, computed, region, memo)
+                    val = ev.eval(eq.rhs, tiles, computed, memo)
                     val = jnp.asarray(val, dtype=dtype)
-                    val = jnp.broadcast_to(
-                        val, tuple(hi - lo for lo, hi in region))
-                    if mask is not None:
-                        val = jnp.where(mask, val, jnp.zeros_like(val))
+                    val = jnp.broadcast_to(val, rshape)
                     base = computed.get(name, tiles[name][0])
+                    base_slice = base[region_idxs(name, region)]
+                    sel = mask
+                    if eq.cond is not None:
+                        cm = ev.eval(eq.cond, tiles, computed, memo)
+                        cm = jnp.broadcast_to(cm, rshape)
+                        sel = cm if sel is None else sel & cm
+                    if eq.step_cond is not None:
+                        sc = ev.eval(eq.step_cond, tiles, computed, memo)
+                        sc = jnp.broadcast_to(sc, rshape)
+                        sel = sc if sel is None else sel & sc
+                    # unselected points keep the base (evicted-slot /
+                    # earlier-write) values — ghosts there are zero, so
+                    # the zero-outside-domain invariant is preserved
+                    if sel is not None:
+                        val = jnp.where(sel, val, base_slice)
                     computed[name] = base.at[region_idxs(name, region)] \
                         .set(val)
 
@@ -408,12 +494,14 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 tuple(block[d] for d in lead) + (sizes[minor],),
                 lambda *pid: tuple(pid) + (0,)))
 
-    in_specs = [pl.BlockSpec(memory_space=pltpu.ANY)] * n_inputs
+    # input 0 is the step-index scalar in SMEM; the rest stay in HBM
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] \
+        + [pl.BlockSpec(memory_space=pltpu.ANY)] * (n_inputs - 1)
     scratch_shapes = []
     for n in var_order:
         for _ in range(slots[n]):
             scratch_shapes.append(pltpu.VMEM(tile_shape(n), dtype))
-    scratch_shapes.append(pltpu.SemaphoreType.DMA((n_inputs,)))
+    scratch_shapes.append(pltpu.SemaphoreType.DMA((n_inputs - 1,)))
 
     call = pl.pallas_call(
         kernel,
@@ -425,10 +513,11 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         interpret=interpret,
     )
 
-    def chunk(state):
-        flat = []
+    def chunk(state, t0):
+        flat = [jnp.asarray(t0, dtype=jnp.int32).reshape(1)]
         for n in var_order:
-            flat.extend(state[n])
+            for a in state[n]:
+                flat.append(a.reshape(1) if a.ndim == 0 else a)
         outs = call(*flat)
         new_state = dict(state)
         oi = 0

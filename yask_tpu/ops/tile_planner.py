@@ -36,9 +36,8 @@ def plan_blocks(program, fuse_steps: int = 1,
     lead = dims[:-1]
     minor = dims[-1]
     sizes = {d: program.sizes[d] for d in dims}
-    halos = ana.max_halos()
-    rad = {d: max(halos.get(d, (0, 0))) for d in lead}
-    hK = {d: rad[d] * fuse_steps for d in lead}
+    rad = ana.fused_step_radius()
+    hK = {d: rad.get(d, 0) * fuse_steps for d in lead}
     sub = sublane_count(program.dtype)
 
     fold = program.soln.get_settings().fold
@@ -66,12 +65,13 @@ def plan_blocks(program, fuse_steps: int = 1,
     import numpy as np
     esize = np.dtype(program.dtype).itemsize
     nbuf = 0
-    minor_ext = 0
+    minor_ext = 1
     for n, g in program.geoms.items():
         slots = g.alloc if (g.has_step and g.is_written) else 1
         nbuf += slots + (1 if g.is_written else 0)
-        pl_, pr_ = g.pads[minor]
-        minor_ext = max(minor_ext, sizes[minor] + pl_ + pr_)
+        if minor in g.domain_dims:
+            pl_, pr_ = g.pads[minor]
+            minor_ext = max(minor_ext, sizes[minor] + pl_ + pr_)
 
     def tile_bytes(blk):
         per = 1

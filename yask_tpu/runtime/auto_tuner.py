@@ -58,7 +58,7 @@ class AutoTuner:
                     pfn = ctx._get_pallas_chunk(k)
                 except Exception:
                     continue  # tile wouldn't fit VMEM etc.
-                compiled = lambda st, t, _f=pfn: _f(st)
+                compiled = pfn
             else:
                 compiled = ctx._get_compiled_chunk(k)
             # warmup call (not timed — excludes dispatch jitter)

@@ -523,7 +523,7 @@ class StencilContext:
         with self._run_timer:
             st = self._state
             for _ in range(groups):
-                st = fn(st)
+                st = fn(st, t)
                 t += K * dirn
             jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
         self._state = st
