@@ -116,6 +116,27 @@ class SSGElasticStencil(ElasticBase):
 
 
 @register_solution
+class SSG2ElasticStencil(SSGElasticStencil):
+    """'ssg2': the reference's v2-base variant of the SSG solution
+    (``SSGElastic2Stencil.cpp:160``); same physics, registered separately
+    so command lines using either name work."""
+
+    def __init__(self):
+        super().__init__("ssg2", radius=2)
+
+
+@register_solution
+class SSGMergedElasticStencil(SSGElasticStencil):
+    """'ssg_merged': the merged-equation variant
+    (``SSGElastic2Stencil.cpp:169``). On TPU the distinction is moot —
+    XLA fuses either form into the same kernels — so this registers the
+    same equations under the merged name for CLI parity."""
+
+    def __init__(self):
+        super().__init__("ssg_merged", radius=2)
+
+
+@register_solution
 class FSGElasticStencil(ElasticBase):
     """'fsg': fully-staggered anisotropic elastic with an orthorhombic
     stiffness tensor (c11…c66 material vars), the structural analog of the
@@ -178,3 +199,91 @@ class FSGElasticStencil(ElasticBase):
         s["xy"](t + 1, x, y, z).EQUALS(
             s["xy"](t, x, y, z)
             + C["66"](x, y, z) * (e[("x", "y")] + e[("y", "x")]))
+
+
+@register_solution
+class FSG2ElasticStencil(FSGElasticStencil):
+    """'fsg2': v2-base variant name of the FSG solution
+    (``FSGElastic2Stencil.cpp:502``)."""
+
+    def __init__(self):
+        super().__init__("fsg2", radius=2)
+
+
+@register_solution
+class FSGElasticABCStencil(ElasticBase):
+    """'fsg_abc': FSG with separable absorbing-boundary damping factors
+    (1-D sponge vars per dim, like the AWP Cerjan factors)."""
+
+    def __init__(self, name: str = "fsg_abc", radius: int = 2):
+        super().__init__(name, radius)
+
+    def define(self):
+        t = self.new_step_index("t")
+        x = self.new_domain_index("x")
+        y = self.new_domain_index("y")
+        z = self.new_domain_index("z")
+        d = (x, y, z)
+        ax = {"x": 0, "y": 1, "z": 2}
+
+        v = {c: self.new_var(f"v_{c}", [t, x, y, z]) for c in "xyz"}
+        s = {c: self.new_var(f"s_{c}", [t, x, y, z])
+             for c in ("xx", "yy", "zz", "xy", "xz", "yz")}
+        rho = self.new_var("rho", [x, y, z])
+        C = {nm: self.new_var(f"c{nm}", [x, y, z])
+             for nm in ("11", "12", "13", "22", "23", "33",
+                        "44", "55", "66")}
+        spx = self.new_var("sponge_x", [x])
+        spy = self.new_var("sponge_y", [y])
+        spz = self.new_var("sponge_z", [z])
+
+        def damp(expr):
+            return expr * spx(x) * spy(y) * spz(z)
+
+        for c in "xyz":
+            i = ax[c]
+            buoy = 1.0 / self._avg2(rho, d, i)
+            names = {"x": ("xx", "xy", "xz"),
+                     "y": ("xy", "yy", "yz"),
+                     "z": ("xz", "yz", "zz")}[c]
+            div = self._dstag(s[names[0]], t, d, 0, 1 if c == "x" else 0)
+            div = div + self._dstag(s[names[1]], t, d, 1,
+                                    1 if c == "y" else 0)
+            div = div + self._dstag(s[names[2]], t, d, 2,
+                                    1 if c == "z" else 0)
+            v[c](t + 1, x, y, z).EQUALS(
+                damp(v[c](t, x, y, z) + buoy * div))
+
+        e = {}
+        for c in "xyz":
+            for j in "xyz":
+                shift = 0 if c == j else 1
+                e[(c, j)] = self._dstag(v[c], t + 1, d, ax[j], shift)
+
+        exx, eyy, ezz = e[("x", "x")], e[("y", "y")], e[("z", "z")]
+        s["xx"](t + 1, x, y, z).EQUALS(
+            s["xx"](t, x, y, z) + C["11"](x, y, z) * exx
+            + C["12"](x, y, z) * eyy + C["13"](x, y, z) * ezz)
+        s["yy"](t + 1, x, y, z).EQUALS(
+            s["yy"](t, x, y, z) + C["12"](x, y, z) * exx
+            + C["22"](x, y, z) * eyy + C["23"](x, y, z) * ezz)
+        s["zz"](t + 1, x, y, z).EQUALS(
+            s["zz"](t, x, y, z) + C["13"](x, y, z) * exx
+            + C["23"](x, y, z) * eyy + C["33"](x, y, z) * ezz)
+        s["yz"](t + 1, x, y, z).EQUALS(
+            s["yz"](t, x, y, z)
+            + C["44"](x, y, z) * (e[("y", "z")] + e[("z", "y")]))
+        s["xz"](t + 1, x, y, z).EQUALS(
+            s["xz"](t, x, y, z)
+            + C["55"](x, y, z) * (e[("x", "z")] + e[("z", "x")]))
+        s["xy"](t + 1, x, y, z).EQUALS(
+            s["xy"](t, x, y, z)
+            + C["66"](x, y, z) * (e[("x", "y")] + e[("y", "x")]))
+
+
+@register_solution
+class FSG2ElasticABCStencil(FSGElasticABCStencil):
+    """'fsg2_abc': v2-base name of the FSG ABC variant."""
+
+    def __init__(self):
+        super().__init__("fsg2_abc", radius=2)
