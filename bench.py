@@ -6,27 +6,84 @@ warmup (excluded, covers XLA compile), then N timed trials; report the
 "mid" (median) throughput in GPts/s — the reference's primary fitness
 metric (``context.cpp:449-460``, ``YaskUtils.pm:40``).
 
+After the XLA-path measurement it opportunistically tries the fused
+Pallas path (temporal fusion, K=wf_steps): the candidate is first
+validated against the XLA path on a small domain, then timed; the best
+mode wins. Any Pallas failure falls back to the XLA number.
+
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "GPts/s", "vs_baseline": N}
 vs_baseline is measured against the BASELINE.md target of 500 GPts/s/chip.
 """
 
 import json
+import os
 import sys
 import time
 
 
-def main():
-    import jax
+def build(fac, env, g, mode="jit", wf=0, radius=8):
+    ctx = fac.new_solution(env, stencil="iso3dfd", radius=radius)
+    ctx.apply_command_line_options(f"-g {g}")
+    ctx.get_settings().mode = mode
+    ctx.get_settings().wf_steps = wf
+    ctx.prepare_solution()
+    ctx.get_var("pressure").set_element(1.0, [0, g // 2, g // 2, g // 2])
+    ctx.get_var("vel").set_all_elements_same(0.1)
+    return ctx
+
+
+def measure(ctx, g, steps_per_trial, trials):
     import numpy as np
+    # warmup (compile)
+    ctx.run_solution(0, steps_per_trial - 1)
+    rates = []
+    t = steps_per_trial
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        ctx.run_solution(t, t + steps_per_trial - 1)
+        dt = time.perf_counter() - t0
+        t += steps_per_trial
+        rates.append(g ** 3 * steps_per_trial / dt / 1e9)
+    s = ctx.get_var("pressure").get_elements_in_slice(
+        [t, g // 2 - 1, g // 2 - 1, g // 2 - 1],
+        [t, g // 2 + 1, g // 2 + 1, g // 2 + 1])
+    if not np.isfinite(s).all():
+        raise RuntimeError("non-finite field")
+    rates.sort()
+    return rates[len(rates) // 2]
+
+
+def try_pallas(fac, env, g, steps_per_trial, trials):
+    """Validated + timed fused-Pallas attempt; returns (rate, K) or None."""
+    best = None
+    for K in (2, 4):
+        try:
+            # correctness gate on a small domain first
+            small = 64
+            a = build(fac, env, small, "jit")
+            a.run_solution(0, 2 * K - 1)
+            b = build(fac, env, small, "pallas", wf=K)
+            b.run_solution(0, 2 * K - 1)
+            if a.compare_data(b, epsilon=1e-3, abs_epsilon=1e-4):
+                continue
+            ctx = build(fac, env, g, "pallas", wf=K)
+            rate = measure(ctx, g, steps_per_trial, trials)
+            if best is None or rate > best[0]:
+                best = (rate, K)
+        except Exception:
+            continue
+    return best
+
+
+def main():
+    import numpy as np  # noqa: F401
     from yask_tpu import yk_factory
 
     fac = yk_factory()
     env = fac.new_env()
     platform = env.get_platform()
 
-    # Pick the largest domain that fits; 512^3 is the reference's
-    # single-device headline config (BASELINE.md).
     sizes = [512, 384, 256] if platform == "tpu" else [128]
     steps_per_trial = 10 if platform == "tpu" else 2
     trials = 3
@@ -34,40 +91,20 @@ def main():
     last_err = None
     for g in sizes:
         try:
-            ctx = fac.new_solution(env, stencil="iso3dfd", radius=8)
-            ctx.apply_command_line_options(f"-g {g}")
-            ctx.prepare_solution()
-            ctx.get_var("pressure").set_element(
-                1.0, [0, g // 2, g // 2, g // 2])
-            ctx.get_var("vel").set_all_elements_same(0.1)
-
-            # Warmup: compiles the chunk and runs it once.
-            ctx.run_solution(0, steps_per_trial - 1)
-            ctx.clear_stats()
-
-            rates = []
-            t = steps_per_trial
-            for _ in range(trials):
-                t0 = time.perf_counter()
-                ctx.run_solution(t, t + steps_per_trial - 1)
-                dt = time.perf_counter() - t0
-                t += steps_per_trial
-                rates.append(g ** 3 * steps_per_trial / dt / 1e9)
-            rates.sort()
-            mid = rates[len(rates) // 2]
-
-            # sanity: field stayed finite
-            s = ctx.get_var("pressure").get_elements_in_slice(
-                [t, g // 2 - 1, g // 2 - 1, g // 2 - 1],
-                [t, g // 2 + 1, g // 2 + 1, g // 2 + 1])
-            if not np.isfinite(s).all():
-                raise RuntimeError("non-finite field")
-
+            ctx = build(fac, env, g, "jit")
+            rate = measure(ctx, g, steps_per_trial, trials)
+            mode = "jit"
+            del ctx
+            if os.environ.get("YT_BENCH_PALLAS", "1") == "1":
+                p = try_pallas(fac, env, g, steps_per_trial, trials)
+                if p is not None and p[0] > rate:
+                    rate, mode = p[0], f"pallas-K{p[1]}"
             print(json.dumps({
-                "metric": f"iso3dfd r=8 {g}^3 fp32 {platform} throughput",
-                "value": round(mid, 3),
+                "metric": f"iso3dfd r=8 {g}^3 fp32 {platform} "
+                          f"throughput ({mode})",
+                "value": round(rate, 3),
                 "unit": "GPts/s",
-                "vs_baseline": round(mid / 500.0, 4),
+                "vs_baseline": round(rate / 500.0, 4),
             }))
             return 0
         except Exception as e:  # try a smaller domain

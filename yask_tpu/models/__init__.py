@@ -1,0 +1,15 @@
+"""Alias package: the framework's "models" are stencil solutions.
+
+In an ML framework this directory would hold model families; in a stencil
+framework the equivalent artifact is the solution library — seismic
+(iso3dfd, ssg/fsg, awp, tti), 2-D physics (wave2d, swe2d), filters, and
+the feature-coverage test fixtures. They live in
+:mod:`yask_tpu.stencils`; this alias re-exports the registry for
+discoverability.
+"""
+
+from yask_tpu.stencils import *  # noqa: F401,F403
+from yask_tpu.compiler.solution_base import (  # noqa: F401
+    create_solution,
+    get_registered_solutions,
+)
