@@ -1,0 +1,62 @@
+"""Tool CLI tests: launcher, bitwise checker, analyze_trace main,
+re-preparation robustness."""
+
+import io
+import sys
+
+import numpy as np
+import pytest
+
+from yask_tpu import yk_factory
+
+
+def test_launcher_builds_command(monkeypatch, capsys):
+    from yask_tpu.tools import launch
+    # domain divisible by the launcher's default ranks-per-device mesh
+    rc = launch.main(["-stencil", "3axis", "-g", "16",
+                      "-trial_steps", "2", "-num_trials", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "equivalent command" in out
+    assert "mid-throughput" in out
+
+
+def test_bitwise_check_same_backend(capsys):
+    from yask_tpu.tools.bitwise_check import main
+    rc = main(["-stencil", "3axis", "-g", "12", "-steps", "2",
+               "-backends", "cpu,cpu"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "BITWISE MATCH" in out
+
+
+def test_analyze_trace_cli(tmp_path, capsys):
+    from yask_tpu.tools.analyze_trace import main
+    env = yk_factory().new_env()
+    for tag in ("a", "b"):
+        ctx = yk_factory().new_solution(env, stencil="test_1d")
+        ctx.apply_command_line_options("-g 16")
+        ctx.prepare_solution()
+        ctx.get_var("u").set_elements_in_seq(0.1)
+        ctx.set_trace_dir(str(tmp_path / tag))
+        ctx.run_solution(0, 2)
+    assert main([str(tmp_path / "a"), str(tmp_path / "b")]) == 0
+    assert "agree" in capsys.readouterr().out
+    assert main(["onlyone"]) == 2
+
+
+def test_reprepare_resets_state():
+    env = yk_factory().new_env()
+    ctx = yk_factory().new_solution(env, stencil="3axis", radius=1)
+    ctx.apply_command_line_options("-g 12")
+    ctx.prepare_solution()
+    ctx.get_var("A").set_all_elements_same(5.0)
+    ctx.run_solution(0, 1)
+    # change geometry and re-prepare: fresh zeroed state, step reset
+    ctx.set_overall_domain_size("x", 16)
+    ctx.set_rank_domain_size("x", 0)
+    ctx.prepare_solution()
+    v = ctx.get_var("A")
+    assert v.get_element([0, 0, 0, 0]) == 0.0
+    assert ctx._cur_step == 0
+    ctx.run_solution(0, 0)
