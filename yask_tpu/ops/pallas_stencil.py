@@ -261,9 +261,10 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                     sr[d] = max(sr[d], l, r)
         stage_r.append(sr)
     # full-step shrink per dim = sum over stages; fused halo = K x that
-    # (identical by construction to ana.fused_step_radius, which the
-    # runtime uses to plan pads)
-    rad = {d: ana.fused_step_radius().get(d, 0) for d in lead}
+    # (fused_step_radius is the single source both here and in the
+    # runtime's pad planning)
+    rad_all = ana.fused_step_radius()
+    rad = {d: rad_all.get(d, 0) for d in lead}
     hK = {d: rad[d] * K for d in lead}
 
     sizes = {d: program.sizes[d] for d in dims}
@@ -340,8 +341,6 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     stage_eqs = [[eq for part in st.parts for eq in part.eqs]
                  for st in ana.stages]
 
-    #: does any equation reference the step index (t-as-value / IF_STEP)?
-    needs_t = any(eq.step_cond is not None for eq in ana.eqs)
     dirn = ana.step_dir
 
     n_inputs = sum(slots[n] for n in var_order) + 1  # +1: t0 scalar
@@ -496,7 +495,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
 
     # input 0 is the step-index scalar in SMEM; the rest stay in HBM
     in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] \
-        + [pl.BlockSpec(memory_space=pltpu.ANY)] * (n_inputs - 1)
+        + [pl.BlockSpec(memory_space=pl.ANY)] * (n_inputs - 1)
     scratch_shapes = []
     for n in var_order:
         for _ in range(slots[n]):
