@@ -62,6 +62,30 @@ def test_shard_map_2d_mesh_matches_ref(env, ssg_ref):
     assert ctx.compare_data(ssg_ref) == 0
 
 
+def test_sharded_3d_mesh(env):
+    """Full 3-D decomposition (2×2×2) with non-constant coefficients."""
+    from yask_tpu.runtime.init_utils import init_solution_vars
+
+    def run(mode, ranks=()):
+        ctx = yk_factory().new_solution(env, stencil="fsg", radius=2)
+        ctx.apply_command_line_options("-g 16")
+        ctx.get_settings().mode = mode
+        for d, n in ranks:
+            ctx.set_num_ranks(d, n)
+        ctx.prepare_solution()
+        init_solution_vars(ctx)
+        ctx.run_solution(0, 1)
+        return ctx
+
+    ref = run("ref")
+    assert run("sharded",
+               [("x", 2), ("y", 2), ("z", 2)]).compare_data(
+                   ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+    assert run("shard_map",
+               [("x", 2), ("y", 2), ("z", 2)]).compare_data(
+                   ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
 def test_shard_map_minor_dim_split(env, ssg_ref):
     # splitting the minor-most dim exercises lane-adjacent ghost slabs
     ctx = make_ssg(env, "shard_map", ranks=[("z", 2)])
