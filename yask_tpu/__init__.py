@@ -46,3 +46,26 @@ from yask_tpu.compiler.solution_base import (  # noqa: F401
 )
 
 from yask_tpu.runtime.factory import yk_factory  # noqa: F401
+
+
+def quick_run(stencil: str, g: int = 64, steps: int = 10, radius=None,
+              mode: str = "auto", **settings):
+    """One-liner demo/benchmark: build a registered stencil, seq-init its
+    vars, run ``steps`` steps, and return the context (read results via
+    ``ctx.get_var(...)`` / ``ctx.get_stats()``).
+
+    >>> ctx = yask_tpu.quick_run("iso3dfd", g=128, steps=20, radius=4)
+    >>> print(ctx.get_stats().format())
+    """
+    fac = yk_factory()
+    env = fac.new_env()
+    ctx = fac.new_solution(env, stencil=stencil, radius=radius)
+    ctx.apply_command_line_options(f"-g {g}")
+    ctx.get_settings().mode = mode
+    for k, v in settings.items():
+        setattr(ctx.get_settings(), k, v)
+    ctx.prepare_solution()
+    from yask_tpu.runtime.init_utils import init_solution_vars
+    init_solution_vars(ctx)
+    ctx.run_solution(0, steps - 1)
+    return ctx
