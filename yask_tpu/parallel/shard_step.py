@@ -343,7 +343,10 @@ def run_shard_map(ctx, start: int, n: int) -> None:
         ctx._compile_secs += time.perf_counter() - t0c
     fn = ctx._jit_cache[key]
 
-    # Strip global pads → sharded interior blocks.
+    # Strip global pads → sharded interior blocks. Pads are identically
+    # zero (framework invariant), so stripping and re-attaching are pure
+    # device ops — no host round trip.
+    ctx._state_to_device()
     interior = {}
     for k in names:
         g = gprog.geoms[k]
@@ -354,27 +357,21 @@ def run_shard_map(ctx, start: int, n: int) -> None:
             else:
                 idxs.append(slice(None))
         sh = NamedSharding(mesh, specs_for(k))
-        interior[k] = [jax.device_put(np.asarray(a)[tuple(idxs)], sh)
+        interior[k] = [jax.device_put(a[tuple(idxs)], sh)
                        for a in ctx._state[k]]
 
     out = fn(interior, jnp.asarray(start, dtype=jnp.int32))
     jax.block_until_ready(out)
 
-    # Merge interiors back into the padded global state.
+    # Re-attach the (zero) pads on device.
     new_state = {}
     for k in names:
         g = gprog.geoms[k]
-        idxs = []
+        pads = []
         for dn, kind in g.axes:
-            if kind == "domain":
-                idxs.append(slice(g.origin[dn], g.origin[dn] + gsizes[dn]))
-            else:
-                idxs.append(slice(None))
+            pads.append(g.pads[dn] if kind == "domain" else (0, 0))
         ring = []
-        for old, res in zip(ctx._state[k], out[k]):
-            merged = np.asarray(old).copy()
-            merged[tuple(idxs)] = np.asarray(res)
-            ring.append(jax.device_put(merged, ctx._shardings[k])
-                        if ctx._shardings else jnp.asarray(merged))
+        for res in out[k]:
+            ring.append(jnp.pad(res, pads) if pads else res)
         new_state[k] = ring
     ctx._state = new_state
