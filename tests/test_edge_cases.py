@@ -1,0 +1,83 @@
+"""Edge-case battery: user step-alloc overrides, reversed run ranges,
+negative-step tracing, multiple writers with overlapping conditions."""
+
+import os
+
+import numpy as np
+import pytest
+
+from yask_tpu import yk_factory
+from yask_tpu.compiler.solution import yc_factory
+
+
+@pytest.fixture(scope="module")
+def env():
+    return yk_factory().new_env()
+
+
+def test_user_step_alloc_override(env):
+    soln = yc_factory().new_solution("alloc_override")
+    t = soln.new_step_index("t")
+    x = soln.new_domain_index("x")
+    u = soln.new_var("u", [t, x])
+    u.set_step_alloc_size(4)   # keep 4 time levels live
+    u(t + 1, x).EQUALS(0.5 * (u(t, x - 1) + u(t, x + 1)))
+    ctx = yk_factory().new_solution(env, soln)
+    ctx.apply_command_line_options("-g 16")
+    ctx.prepare_solution()
+    assert len(ctx._state["u"]) == 4
+    ctx.get_var("u").set_elements_in_seq(0.1)
+    ctx.run_solution(0, 5)
+    # steps 3..6 retained with alloc 4
+    v = ctx.get_var("u")
+    for tt in (3, 4, 5, 6):
+        v.get_element([tt, 0])
+    with pytest.raises(Exception):
+        v.get_element([2, 0])
+
+
+def test_reversed_range_argument_order(env):
+    a = yk_factory().new_solution(env, stencil="3axis", radius=1)
+    a.apply_command_line_options("-g 10")
+    a.prepare_solution()
+    a.get_var("A").set_elements_in_seq(0.1)
+    a.run_solution(3, 0)     # same as (0, 3)
+    b = yk_factory().new_solution(env, stencil="3axis", radius=1)
+    b.apply_command_line_options("-g 10")
+    b.prepare_solution()
+    b.get_var("A").set_elements_in_seq(0.1)
+    b.run_solution(0, 3)
+    assert a.compare_data(b) == 0
+
+
+def test_reverse_time_trace_negative_steps(env, tmp_path):
+    ctx = yk_factory().new_solution(env, stencil="test_reverse_2d")
+    ctx.apply_command_line_options("-g 8")
+    ctx.prepare_solution()
+    ctx.get_var("u").set_elements_in_seq(0.1)
+    ctx.set_trace_dir(str(tmp_path / "tr"))
+    # reverse stepping evaluates t = 2, 1, 0 → writes steps 1, 0, -1
+    ctx.run_solution(0, 2)
+    files = sorted(os.listdir(tmp_path / "tr"))
+    assert "step_1.npz" in files and "step_-1.npz" in files
+    from yask_tpu.tools.analyze_trace import compare_traces
+    assert compare_traces(str(tmp_path / "tr"), str(tmp_path / "tr")) is None
+
+
+def test_overlapping_condition_writers_last_wins(env):
+    soln = yc_factory().new_solution("overlap_writers")
+    t = soln.new_step_index("t")
+    x = soln.new_domain_index("x")
+    u = soln.new_var("u", [t, x])
+    u(t + 1, x).EQUALS(1.0)
+    u(t + 1, x).EQUALS(2.0).IF_DOMAIN(x < 8)
+    u(t + 1, x).EQUALS(3.0).IF_DOMAIN(x < 4)   # overlaps the previous
+    for mode in ("jit", "ref"):
+        ctx = yk_factory().new_solution(env, soln)
+        ctx.apply_command_line_options("-g 16")
+        ctx.get_settings().mode = mode
+        ctx.prepare_solution()
+        ctx.run_solution(0, 0)
+        got = ctx.get_var("u").get_elements_in_slice([1, 0], [1, 15])
+        want = np.array([3.0] * 4 + [2.0] * 4 + [1.0] * 8, np.float32)
+        np.testing.assert_array_equal(got, want)
