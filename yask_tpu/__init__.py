@@ -63,9 +63,12 @@ def quick_run(stencil: str, g: int = 64, steps: int = 10, radius=None,
     ctx.apply_command_line_options(f"-g {g}")
     ctx.get_settings().mode = mode
     for k, v in settings.items():
+        if not hasattr(ctx.get_settings(), k):
+            raise YaskException(f"unknown kernel setting '{k}'")
         setattr(ctx.get_settings(), k, v)
     ctx.prepare_solution()
     from yask_tpu.runtime.init_utils import init_solution_vars
     init_solution_vars(ctx)
-    ctx.run_solution(0, steps - 1)
+    if steps > 0:
+        ctx.run_solution(0, steps - 1)
     return ctx

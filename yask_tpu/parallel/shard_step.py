@@ -345,8 +345,8 @@ def run_shard_map(ctx, start: int, n: int) -> None:
 
     # Strip global pads → sharded interior blocks. Pads are identically
     # zero (framework invariant), so stripping and re-attaching are pure
-    # device ops — no host round trip.
-    ctx._state_to_device()
+    # device ops — no host round trip. (State is already on device:
+    # run_solution's shard_map branch owns that placement.)
     interior = {}
     for k in names:
         g = gprog.geoms[k]
