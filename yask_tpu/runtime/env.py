@@ -45,7 +45,14 @@ class yk_env:
         return list(self._devices)
 
     def get_platform(self) -> str:
-        return self._devices[0].platform if self._devices else "none"
+        """Normalized platform name: "axon" (the TPU-behind-a-relay PJRT
+        plugin used in this environment) reports as "tpu" so every
+        platform branch (Pallas interpret-vs-Mosaic, bench sizing)
+        treats it as the real device it is."""
+        if not self._devices:
+            return "none"
+        plat = self._devices[0].platform
+        return "tpu" if plat == "axon" else plat
 
     # ---- collectives-over-ranks (single-controller no-ops, kept for API
     # parity with yk_env barriers/reductions) ------------------------------
