@@ -177,7 +177,7 @@ def main():
         }))
         return 0
 
-    on_tpu = platform in ("tpu", "axon")  # axon = TPU behind the relay
+    on_tpu = platform == "tpu"  # yk_env normalizes axon → tpu
     sizes = [512, 384, 256] if on_tpu else [128]
     steps_per_trial = 10 if on_tpu else 2
     trials = 3

@@ -147,3 +147,56 @@ def test_custom_solution_object(env):
     pad = np.pad(arr, 1)
     want = 0.5 * (pad[:-2] + pad[2:])
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_auto_tune_preserves_run_semantics(env):
+    """Online tuning must not replay step indices or skew stats: a tuned
+    run of a t-dependent stencil (IF_STEP) must equal the untuned oracle,
+    with step bookkeeping identical (ADVICE r1: tuner step replay)."""
+    def build(**opts):
+        ctx = yk_factory().new_solution(env, stencil="test_step_cond_1d")
+        ctx.apply_command_line_options("-g 24")
+        for k, v in opts.items():
+            setattr(ctx.get_settings(), k, v)
+        ctx.prepare_solution()
+        ctx.get_var("u").set_elements_in_seq(0.1)
+        return ctx
+
+    tuned = build(do_auto_tune=True, auto_tune_trial_secs=0.02)
+    tuned.run_solution(0, 5)
+    oracle = build(force_scalar=True)
+    oracle.run_solution(0, 5)
+
+    assert tuned.compare_data(oracle) == 0
+    assert tuned._cur_step == oracle._cur_step == 6
+    assert tuned.get_stats().get_num_steps_done() == 6
+
+
+def test_checkpoint_extensionless_path(env, tmp_path):
+    """save/load round trip with a path missing '.npz' (ADVICE r1)."""
+    ctx = make_heat(env, g=12)
+    ctx.get_var("A").set_elements_in_seq(0.2)
+    ctx.run_solution(0, 1)
+    ck = str(tmp_path / "snap")  # no extension
+    ctx.save_checkpoint(ck)
+    other = make_heat(env, g=12)
+    other.load_checkpoint(ck)
+    assert other._cur_step == ctx._cur_step
+    assert other.compare_data(ctx) == 0
+
+
+def test_shard_map_cache_keyed_on_overlap(env):
+    """Toggling -overlap_comms between equal-length runs must not reuse
+    the other strategy's compiled body (ADVICE r1: stale jit cache)."""
+    ctx = yk_factory().new_solution(env, stencil="3axis", radius=1)
+    ctx.apply_command_line_options("-g 16")
+    ctx.get_settings().mode = "shard_map"
+    ctx.set_num_ranks("x", 2)
+    ctx.prepare_solution()
+    ctx.get_var("A").set_elements_in_seq(0.1)
+    ctx.get_settings().overlap_comms = False
+    ctx.run_solution(0, 1)
+    ctx.get_settings().overlap_comms = True
+    ctx.run_solution(2, 3)
+    keys = [k for k in ctx._jit_cache if k[0] == "shard_map"]
+    assert len(keys) == 2 and len({k[2] for k in keys}) == 2

@@ -226,7 +226,10 @@ def run_shard_map(ctx, start: int, n: int) -> None:
                         else None)
         return PartitionSpec(*spec)
 
-    key = ("shard_map", n)
+    # overlap_comms is captured at trace time, so it must key the cache —
+    # otherwise toggling it between equal-length runs silently reuses the
+    # other strategy's compiled body.
+    key = ("shard_map", n, opts.overlap_comms)
     if key not in ctx._jit_cache:
         shard_map = _shard_map_fn()
 

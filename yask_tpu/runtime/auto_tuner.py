@@ -39,18 +39,42 @@ class AutoTuner:
 
     def run_auto_tuner_now(self, candidates: Optional[List[int]] = None,
                            min_trial_secs: Optional[float] = None) -> int:
-        """Time each chunk-length candidate on real steps, pick the best,
-        and record it in ``settings.wf_steps`` (the API twin of
+        """Time each chunk-length candidate, pick the best, and record it
+        in ``settings.wf_steps`` (the API twin of
         ``yk_solution::run_auto_tuner_now``, ``yk_solution_api.hpp:881``).
-        Advances the solution state like the reference's tuner trials."""
+
+        Trials run on a *copy* of the solution state and are discarded:
+        unlike the reference (which folds trial steps into the production
+        run), replayed trial step indices would corrupt t-dependent
+        stencils, so the production run re-executes its full range with
+        the tuned settings and the stats/timers only ever see real steps.
+        The compiled chunks are cached, so trial compilation is reused."""
         import jax
+        import jax.numpy as jnp
         ctx = self.ctx
         cands = list(candidates or self.CHUNK_CANDIDATES)
         trial_secs = (min_trial_secs if min_trial_secs is not None
                       else ctx._opts.auto_tune_trial_secs)
-        best_key, best_rate = None, None
         dirn = ctx._ana.step_dir
         use_pallas = ctx._mode == "pallas"
+
+        ctx._state_to_device()
+        saved_state = ctx._state
+        saved_cur, saved_done = ctx._cur_step, ctx._steps_done
+        # Deep-copy: compiled chunks donate their input buffers, so trials
+        # must not be handed the saved arrays.
+        ctx._state = {k: [jnp.copy(a) for a in ring]
+                      for k, ring in saved_state.items()}
+        try:
+            return self._trial_loop(jax, ctx, cands, trial_secs,
+                                    dirn, use_pallas)
+        finally:
+            ctx._state = saved_state
+            ctx._cur_step, ctx._steps_done = saved_cur, saved_done
+
+    def _trial_loop(self, jax, ctx, cands, trial_secs,
+                    dirn, use_pallas) -> int:
+        best_key, best_rate = None, None
         for k in cands:
             key = (k,)
             if use_pallas:
@@ -67,7 +91,9 @@ class AutoTuner:
             ctx._state = st
             ctx._cur_step += k * dirn
             ctx._steps_done += k
-            # timed calls until the trial budget is spent
+            # timed calls until the trial budget is spent, abandoning the
+            # candidate mid-trial once it is clearly slower than the best
+            # (the reference's eval cutoff, auto_tuner.cpp:206 region)
             calls = 0
             t0 = time.perf_counter()
             while time.perf_counter() - t0 < trial_secs:
@@ -77,15 +103,15 @@ class AutoTuner:
                 ctx._cur_step += k * dirn
                 ctx._steps_done += k
                 calls += 1
+                if best_rate is not None and \
+                        (time.perf_counter() - t0) / (calls * k) \
+                        > 2.0 * best_rate:
+                    break
             elapsed = time.perf_counter() - t0
             per_step = elapsed / max(calls * k, 1)
             self.results[key] = per_step
             if best_rate is None or per_step < best_rate:
                 best_rate, best_key = per_step, key
-            elif per_step > 2.0 * best_rate:
-                # early abandonment (the reference's cutoff,
-                # auto_tuner.cpp eval cutoff logic)
-                continue
         ctx._tuned = True
         if best_key is None:
             # every candidate infeasible (e.g. pallas tiles over the VMEM

@@ -414,8 +414,7 @@ class StencilContext:
         else:
             self._run_jit_steps(start, n)
 
-        self._cur_step = start + (n - 1) * self._ana.step_dir \
-            + self._ana.step_dir
+        self._cur_step = start + n * self._ana.step_dir
         self._steps_done += n
         if self._trace_dir:
             self._trace_dump(self._cur_step)
@@ -501,8 +500,14 @@ class StencilContext:
             interp = self._env.get_platform() != "tpu"
             chunk, tile_bytes = build_pallas_chunk(
                 self._program, fuse_steps=K, block=blk, interpret=interp)
+            self._state_to_device()
             t0c = time.perf_counter()
-            fn = jax.jit(chunk) if not interp else chunk
+            if interp:
+                fn = chunk
+            else:
+                # AOT-compile so the first timed call doesn't include
+                # XLA/Mosaic compilation (mirrors _get_compiled_chunk).
+                fn = jax.jit(chunk).lower(self._state, 0).compile()
             self._jit_cache[key] = fn
             self._compile_secs += time.perf_counter() - t0c
             self._env.trace_msg(
@@ -525,7 +530,7 @@ class StencilContext:
             for _ in range(groups):
                 st = fn(st, t)
                 t += K * dirn
-            jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+            jax.block_until_ready(st)
         self._state = st
         if rem:
             self._run_jit_steps(t, rem)
@@ -641,6 +646,12 @@ class StencilContext:
     # solution snapshot/restore on top of the same state)
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _ckpt_path(path: str) -> str:
+        # np.savez appends '.npz' to extensionless paths; normalize so a
+        # save/load round trip works with any path string.
+        return path if path.endswith(".npz") else path + ".npz"
+
     def save_checkpoint(self, path: str) -> None:
         """Snapshot all var state + step position to an .npz file."""
         self._check_prepared()
@@ -649,12 +660,12 @@ class StencilContext:
         for name, ring in self._state.items():
             for i, a in enumerate(ring):
                 payload[f"{name}__slot{i}"] = np.asarray(a)
-        np.savez(path, **payload)
+        np.savez(self._ckpt_path(path), **payload)
 
     def load_checkpoint(self, path: str) -> None:
         """Restore a snapshot (shapes must match the prepared geometry)."""
         self._check_prepared()
-        data = np.load(path)
+        data = np.load(self._ckpt_path(path))
         new_state: Dict[str, List] = {}
         for name, ring in self._state.items():
             arrs = []
