@@ -162,6 +162,10 @@ def run_harness(argv: Optional[List[str]] = None, out=None) -> int:
         out.write(f"  throughput (num-points/sec): {pts_ps:.6g}\n")
         out.write(f"  throughput (est-FLOPS): "
                   f"{pts_ps * soln_ana.counters.num_ops:.6g}\n")
+        if st.get_halo_secs() > 0:
+            out.write(f"  halo-time (sec): {st.get_halo_secs():.6g}\n")
+            out.write(f"  halo-fraction (%): "
+                      f"{100.0 * st.get_halo_secs() / max(dt, 1e-12):.4g}\n")
 
     rates.sort()
     mid = rates[len(rates) // 2]

@@ -67,7 +67,16 @@ def exchange_ghosts(arr, geom, dim_widths: Dict[str, Tuple[int, int]],
     return arr
 
 
-def _make_overlap_step(prog, nr, lsizes):
+def _no_exchange(arr, geom, dim_widths, nr, local_sizes):
+    """Exchange stand-in for halo-time calibration: the compiled twin with
+    this in place of ``exchange_ghosts`` differs from the real program
+    only by the collectives, so (t_real − t_twin)/t_real is the measured
+    halo fraction (the reference's halo-time breakdown,
+    ``context.hpp:318-328``, recast for fused XLA programs)."""
+    return arr
+
+
+def _make_overlap_step(prog, nr, lsizes, exchange=exchange_ghosts):
     """Interior/exterior-split step: the reference's compute/communication
     overlap (``run_solution`` exterior-then-interior structure,
     ``context.cpp:377-478``, ``MpiSection`` flags ``context.hpp:789-833``)
@@ -122,15 +131,15 @@ def _make_overlap_step(prog, nr, lsizes):
                 if vname in computed:
                     union, grew = widen(post_w.get(vname, {}), widths)
                     if vname not in computed_post or grew:
-                        computed_post[vname] = exchange_ghosts(
+                        computed_post[vname] = exchange(
                             computed[vname], g, union, nr, lsizes)
                         post_w[vname] = union
                 elif g.is_written and g.has_step:
                     union, grew = widen(ring_w.get(vname, {}), widths)
                     if vname not in ring_w or grew:
                         ring = list(state_post[vname])
-                        ring[-1] = exchange_ghosts(ring[-1], g, union, nr,
-                                                   lsizes)
+                        ring[-1] = exchange(ring[-1], g, union, nr,
+                                            lsizes)
                         state_post[vname] = ring
                         ring_w[vname] = union
 
@@ -230,7 +239,8 @@ def run_shard_map(ctx, start: int, n: int) -> None:
     # otherwise toggling it between equal-length runs silently reuses the
     # other strategy's compiled body.
     key = ("shard_map", n, opts.overlap_comms)
-    if key not in ctx._jit_cache:
+
+    def build(exchange):
         shard_map = _shard_map_fn()
 
         in_specs = ({k: [specs_for(k)] * slots[k] for k in names},
@@ -267,7 +277,7 @@ def run_shard_map(ctx, start: int, n: int) -> None:
                 widths = {d: w for d, w in widths.items() if w != (0, 0)}
                 if widths:
                     state[k] = [
-                        exchange_ghosts(a, g, widths, nr, lsizes)
+                        exchange(a, g, widths, nr, lsizes)
                         for a in state[k]]
 
             # 3) scan steps; before each stage refresh stale ghosts only.
@@ -294,7 +304,7 @@ def run_shard_map(ctx, start: int, n: int) -> None:
                             u, grew = union_of((vname, "c"), widths)
                             if grew:
                                 computed = {**computed,
-                                            vname: exchange_ghosts(
+                                            vname: exchange(
                                                 computed[vname], g2, u,
                                                 nr, lsizes)}
                                 applied[(vname, "c")] = u
@@ -302,7 +312,7 @@ def run_shard_map(ctx, start: int, n: int) -> None:
                             u, grew = union_of((vname, "s"), widths)
                             if grew:
                                 ring = list(state_[vname])
-                                ring[-1] = exchange_ghosts(
+                                ring[-1] = exchange(
                                     ring[-1], g2, u, nr, lsizes)
                                 state_ = {**state_, vname: ring}
                                 applied[(vname, "s")] = u
@@ -310,7 +320,8 @@ def run_shard_map(ctx, start: int, n: int) -> None:
 
                 return prog.step(st, t, halo_hook=hook)
 
-            one_step_ov = _make_overlap_step(prog, nr, lsizes)
+            one_step_ov = _make_overlap_step(prog, nr, lsizes,
+                                             exchange=exchange)
             one_step = one_step_ov if ctx._opts.overlap_comms \
                 else one_step_plain
 
@@ -340,9 +351,11 @@ def run_shard_map(ctx, start: int, n: int) -> None:
         except TypeError:  # older jax spells it check_rep
             mapped = shard_map(body, mesh=mesh, in_specs=in_specs,
                                out_specs=out_specs, check_rep=False)
+        return jax.jit(mapped, donate_argnums=0)
+
+    if key not in ctx._jit_cache:
         t0c = time.perf_counter()
-        fn = jax.jit(mapped, donate_argnums=0)
-        ctx._jit_cache[key] = fn
+        ctx._jit_cache[key] = build(exchange_ghosts)
         ctx._compile_secs += time.perf_counter() - t0c
     fn = ctx._jit_cache[key]
 
@@ -363,8 +376,50 @@ def run_shard_map(ctx, start: int, n: int) -> None:
         interior[k] = [jax.device_put(a[tuple(idxs)], sh)
                        for a in ctx._state[k]]
 
+    # Halo-time calibration (once per compiled variant): time the real
+    # program against its no-exchange twin on copies of the interiors;
+    # the shortfall is the halo cost this variant pays per call. With
+    # -overlap_comms the fraction shrinks — the overlap payoff the
+    # reference reports via its MPI wait timers (context.hpp:318-328).
+    frac = 0.0
+    if opts.measure_halo_time:
+        cal = ctx._halo_frac
+        if key not in cal:
+            t0c = time.perf_counter()
+            fn_no = build(_no_exchange)
+            ctx._compile_secs += time.perf_counter() - t0c
+
+            def timed(f):
+                st = {k: [jnp.copy(a) for a in ring]
+                      for k, ring in interior.items()}
+                t = jnp.asarray(start, dtype=jnp.int32)
+                st = f(st, t)           # warmup (compile + first dispatch)
+                jax.block_until_ready(st)
+                # repeat until the sample is long enough to be stable
+                calls = 0
+                t0 = time.perf_counter()
+                while calls < 8:
+                    st = f(st, t)
+                    jax.block_until_ready(st)
+                    calls += 1
+                    if time.perf_counter() - t0 >= 0.05 and calls >= 2:
+                        break
+                return (time.perf_counter() - t0) / calls
+
+            t_no = timed(fn_no)
+            t_ex = timed(fn)
+            cal[key] = max(0.0, 1.0 - t_no / t_ex) if t_ex > 0 else 0.0
+            del fn_no
+        frac = cal[key]
+
+    # The timed window covers only the production call — calibration and
+    # twin compilation above are excluded, like all compile/warmup time.
+    t0r = time.perf_counter()
     out = fn(interior, jnp.asarray(start, dtype=jnp.int32))
     jax.block_until_ready(out)
+    dt = time.perf_counter() - t0r
+    ctx._run_timer._elapsed += dt
+    ctx._halo_timer._elapsed += frac * dt
 
     # Re-attach the (zero) pads on device.
     new_state = {}

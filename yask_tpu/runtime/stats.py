@@ -69,4 +69,6 @@ class yk_stats:
                 f"throughput (GPts/s): {gpts:.6g}\n"
                 f"throughput (est-FLOPS): {self.get_flops():.6g}\n"
                 f"halo-time (sec): {self._halo:.6g}\n"
+                f"halo-fraction (%): "
+                f"{100.0 * self._halo / self._elapsed if self._elapsed else 0.0:.4g}\n"
                 f"compile-time (sec): {self._compile:.6g}\n")
