@@ -54,7 +54,7 @@ def test_reverse_time_trace_negative_steps(env, tmp_path):
     ctx = yk_factory().new_solution(env, stencil="test_reverse_2d")
     ctx.apply_command_line_options("-g 8")
     ctx.prepare_solution()
-    ctx.get_var("u").set_elements_in_seq(0.1)
+    ctx.get_var("A").set_elements_in_seq(0.1)
     ctx.set_trace_dir(str(tmp_path / "tr"))
     # reverse stepping evaluates t = 2, 1, 0 → writes steps 1, 0, -1
     ctx.run_solution(0, 2)

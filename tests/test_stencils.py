@@ -49,6 +49,9 @@ def test_registry_not_empty():
 def test_stencil_analyzes(name):
     sb = create_solution(name, radius=RADII.get(name))
     ana = sb.get_soln().analyze()
+    if name.startswith("test_empty"):  # legitimately no equations
+        assert len(ana.stages) == 0
+        return
     assert len(ana.stages) >= 1
     assert ana.counters.num_ops > 0
 

@@ -53,7 +53,7 @@ def create_solution(name: str, radius: Optional[int] = None,
             raise YaskException(f"stencil '{name}' takes no radius")
         if not obj.set_radius(radius):
             raise YaskException(f"invalid radius {radius} for '{name}'")
-    obj.define()
+    obj.run_define()
     return obj
 
 
@@ -68,6 +68,19 @@ class yc_solution_base:
     def __init__(self, name: str):
         self._soln = yc_factory().new_solution(name)
         self._nfac = yc_node_factory()
+        self._defined = False
+
+    def run_define(self) -> None:
+        """Run ``define()`` exactly once. Content (vars or equations)
+        also counts as already-defined so user code that called
+        ``define()`` directly keeps working; the explicit flag covers
+        legal zero-content solutions (test_empty family)."""
+        if self._defined or self._soln.get_num_equations() > 0 \
+                or self._soln.get_vars():
+            self._defined = True
+            return
+        self.define()
+        self._defined = True
 
     def get_soln(self) -> yc_solution:
         return self._soln

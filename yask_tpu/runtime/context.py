@@ -50,8 +50,7 @@ class StencilContext:
         from yask_tpu.compiler.solution_base import yc_solution_base
         from yask_tpu.compiler.lowering import CompiledSolution
         if isinstance(source, yc_solution_base):
-            if source.get_soln().get_num_equations() == 0:
-                source.define()
+            source.run_define()
             soln = source.get_soln()
             self._csol = soln.compile(dtype=dtype)
         elif isinstance(source, yc_solution):

@@ -287,3 +287,23 @@ class FSG2ElasticABCStencil(FSGElasticABCStencil):
 
     def __init__(self):
         super().__init__("fsg2_abc", radius=2)
+
+
+@register_solution
+class FSGMergedElasticStencil(FSG2ElasticStencil):
+    """Back-compat alias of fsg2 (reference ``FSGElasticMStencil``,
+    ``FSGElastic2Stencil.cpp:510``)."""
+
+    def __init__(self):
+        super().__init__()
+        self._soln._name = "fsg_merged"
+
+
+@register_solution
+class FSGMergedABCElasticStencil(FSG2ElasticABCStencil):
+    """Back-compat alias of fsg2_abc (reference ``FSGABCElasticMStencil``,
+    ``FSGElastic2Stencil.cpp:517``)."""
+
+    def __init__(self):
+        super().__init__()
+        self._soln._name = "fsg_merged_abc"
