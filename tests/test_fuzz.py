@@ -18,22 +18,39 @@ def random_solution(rng, idx):
     dims = [soln.new_domain_index(d) for d in ["x", "y", "z"][:nd]]
     nvars = rng.randint(1, 4)
     vars_ = [soln.new_var(f"v{i}", [t] + dims) for i in range(nvars)]
-    coeff = soln.new_var("k", dims) if rng.rand() < 0.5 else None
+    # coefficient var, sometimes carrying a misc (channel-style) dim
+    coeff = None
+    coeff_misc = False
+    if rng.rand() < 0.5:
+        if rng.rand() < 0.4:
+            m = soln.new_misc_index("m")
+            coeff = soln.new_var("k", [m] + dims)
+            coeff_misc = True
+        else:
+            coeff = soln.new_var("k", dims)
+    # scratch var: written from the vars, read at offsets by final eqs
+    scratch = soln.new_scratch_var("s", dims) if rng.rand() < 0.4 else None
 
-    def rand_expr(depth=0):
+    def rand_expr(depth=0, allow_scratch=False):
         r = rng.rand()
-        if depth > 2 or r < 0.35:
+        if depth > 2 or r < 0.3:
             v = vars_[rng.randint(nvars)]
             offs = [int(rng.randint(-2, 3)) for _ in dims]
             so = 0 if rng.rand() < 0.8 else -1
             args = [t + so] + [d + o for d, o in zip(dims, offs)]
             p = v(*args)
             return p
-        if r < 0.45:
+        if r < 0.4:
             return E.ConstExpr(float(np.round(rng.uniform(-1, 1), 3)))
-        if r < 0.55 and coeff is not None:
+        if r < 0.5 and coeff is not None:
+            if coeff_misc:
+                return coeff(int(rng.randint(-1, 2)), *dims)
             return coeff(*dims)
-        a, b = rand_expr(depth + 1), rand_expr(depth + 1)
+        if r < 0.58 and allow_scratch and scratch is not None:
+            offs = [int(rng.randint(-2, 3)) for _ in dims]
+            return scratch(*[d + o for d, o in zip(dims, offs)])
+        a = rand_expr(depth + 1, allow_scratch)
+        b = rand_expr(depth + 1, allow_scratch)
         op = rng.choice(["+", "-", "*"])
         if op == "+":
             return a + b
@@ -41,8 +58,10 @@ def random_solution(rng, idx):
             return a - b
         return a * E.ConstExpr(0.3) + b * E.ConstExpr(0.2)
 
+    if scratch is not None:
+        scratch(*dims).EQUALS(rand_expr(depth=1) * 0.3)
     for v in vars_:
-        rhs = rand_expr() * 0.2 + v(t, *dims) * 0.5
+        rhs = rand_expr(allow_scratch=True) * 0.2 + v(t, *dims) * 0.5
         eq = v(t + 1, *dims).EQUALS(rhs)
         if rng.rand() < 0.3 and len(dims) >= 1:
             eq.IF_DOMAIN(dims[0] >= 3)

@@ -94,8 +94,17 @@ def test_pallas_multi_stage_ssg(env):
 @pytest.mark.parametrize("name,radius", [
     ("iso3dfd_sponge", 2),   # partial-dim (1-D) coefficient vars
     ("awp", None),           # 4 stages, IF_DOMAIN conditions, 0-dim var
-    ("test_partial_3d", None),  # reordered partial-dim var (cyz(z,y))
+    ("test_partial_3d", None),  # reordered/partial/scalar/step-only vars
     ("test_step_cond_1d", None),  # IF_STEP — 1-D, expect fallback error
+    ("test_scratch_2d", None),  # 3-level scratch chain with reuse
+    ("test_scratch_3d", None),  # diamond scratch deps
+    ("swe2d", None),         # scratch-using physics (was a fallback)
+    ("tti", 2),              # trig scratch + rotated ops + 3-slot ring
+    ("box", None),           # written var with a misc (channel) dim
+    ("gaussian", None),      # misc-dim separable filter
+    ("test_misc_2d", None),  # interleaved misc dims, misc-only vars
+    ("test_stream_3d", None),  # zero spatial halo + deep time ring
+    ("test_boundary_3d", None),  # box-interior IF_DOMAIN pair
 ])
 def test_pallas_condition_and_partial_class(env, name, radius):
     from yask_tpu.runtime.init_utils import init_solution_vars
@@ -122,15 +131,15 @@ def test_pallas_condition_and_partial_class(env, name, radius):
 def test_pallas_applicability_rules():
     assert pallas_applicable(
         create_solution("3axis", radius=1).get_soln().compile())[0]
-    # multi-stage chains and condition-bearing solutions are supported
-    assert pallas_applicable(
-        create_solution("ssg", radius=2).get_soln().compile())[0]
-    assert pallas_applicable(
-        create_solution("awp").get_soln().compile())[0]
-    # scratch-var solutions still fall back
+    # multi-stage chains, conditions, scratch, misc dims, deep rings are
+    # all supported now
+    for name in ("ssg", "awp", "swe2d", "tti", "box", "test_stream_3d"):
+        assert pallas_applicable(
+            create_solution(name).get_soln().compile())[0], name
+    # 1-D solutions stay on the XLA path (nothing to tile)
     ok, why = pallas_applicable(
-        create_solution("swe2d").get_soln().compile())
-    assert not ok and "scratch" in why
+        create_solution("test_1d").get_soln().compile())
+    assert not ok and "domain dims" in why
 
 
 def test_pallas_rejects_fusion_beyond_planned_pad(env):
@@ -148,9 +157,8 @@ def test_pallas_rejects_fusion_beyond_planned_pad(env):
 
 
 def test_pallas_mode_rejects_inapplicable(env):
-    # swe2d uses scratch vars → not pallas-eligible (falls back with a
-    # named reason)
-    ctx = yk_factory().new_solution(env, stencil="swe2d")
+    # 1-D solutions are not pallas-eligible (named reason in the error)
+    ctx = yk_factory().new_solution(env, stencil="test_scratch_1d")
     ctx.apply_command_line_options("-g 16")
     ctx.get_settings().mode = "pallas"
     with pytest.raises(YaskException):

@@ -18,10 +18,13 @@ HBM traffic per K steps ≈ one read + one write of each var, versus K of
 each for the unfused path — the same arithmetic-intensity win wave-front
 tiling buys the reference.
 
-Applicability (checked by :func:`pallas_applicable`): single stage, no
-sub-domain/step conditions, no scratch vars, no index-value expressions,
-ring allocation ≤ 2, every var spanning all domain dims in the same order.
-Everything else falls back to the XLA-fused path.
+Applicability (checked by :func:`pallas_applicable`): ≥ 2 domain dims and
+written vars spanning all domain dims (misc axes on them are fine — the
+LHS misc values pin the write position). Multi-stage chains, sub-
+domain/step conditions, scratch-var chains (evaluated in-tile over
+write-halo-expanded regions), misc-dim and partial-dim read-only vars,
+and arbitrary ring depth are all handled in-kernel; the rest falls back
+to the XLA-fused path.
 """
 
 from __future__ import annotations
@@ -56,23 +59,21 @@ def pallas_applicable(csol) -> Tuple[bool, str]:
     """Can this solution run on the Pallas fused path? Supported: multi-
     stage chains (ssg/fsg-class), sub-domain/step conditions (awp-class —
     lowered to in-tile masks over global coordinates), index-value
-    expressions, and partial-dim read-only coefficient vars (sponge
-    factors). Excluded: scratch vars, misc dims, partial-dim *written*
-    vars, ring allocation > 2."""
+    expressions, partial-dim read-only coefficient vars (sponge factors),
+    scratch-var chains evaluated in-tile over expanded regions
+    (tti/swe2d-class), misc-dim vars including written ones (filter
+    kernels — constant LHS misc values pin the write), and any ring
+    allocation (deep time reads, 2nd-order-in-time schemes). Excluded:
+    partial-dim *written* vars (a tile owner for a var lacking grid dims
+    is ambiguous) and 1-D solutions (nothing to tile)."""
     ana = csol.ana
     if len(ana.domain_dims) < 2:
         return False, "needs >= 2 domain dims"
     for v in csol.soln.get_vars():
-        if v.is_scratch():
-            return False, "has scratch vars"
-        if v.misc_dim_names():
-            return False, "has misc dims"
         if v.is_written:
             if v.domain_dim_names() != ana.domain_dims:
                 return False, (f"written var '{v.get_name()}' must span "
                                "all domain dims")
-            if v.get_step_alloc_size() > 2:
-                return False, "ring allocation > 2"
     return True, "ok"
 
 
@@ -105,6 +106,7 @@ class _TileEval:
         self.gidx_base = None       # per lead dim: traced global offset of
         #                             tile position 0 (pid*block - hK)
         self.t = None               # step-index value (traced or None)
+        self.scratch = {}           # scratch var -> full-tile value
 
     def global_index(self, d: str):
         """Global coordinate array for dim d over the current region,
@@ -123,7 +125,12 @@ class _TileEval:
         g = self.program.geoms[name]
         so = p.step_offset()
         region = self.region
-        if name in computed and so is not None and so == self.step_dir:
+        if g.is_scratch:
+            # Scratch values live as full-tile arrays computed earlier in
+            # this sub-step over an expanded region, so offset slicing
+            # works exactly like ring tiles.
+            arr = self.scratch[name]
+        elif name in computed and so is not None and so == self.step_dir:
             # Same-step read of an earlier stage's output: computed values
             # are kept as FULL tiles (written via .at[region].set on the
             # evicted base), so offset slicing works exactly like rings.
@@ -134,10 +141,20 @@ class _TileEval:
                 arr = ring[-1]
             else:
                 idx = len(ring) - 1 + so * self.step_dir
+                if not (0 <= idx < len(ring)):
+                    # mirror the XLA path's bounds check — a negative
+                    # Python index would silently wrap to the newest slot
+                    raise YaskException(
+                        f"step offset {so} of '{name}' outside its "
+                        f"allocation {len(ring)}")
                 arr = ring[idx]
         offs = p.domain_offsets()
+        misc = p.misc_vals()
         idxs = []
         for dn, kind in g.axes:   # var's own axis order
+            if kind == "misc":
+                idxs.append(misc[dn] - g.misc_lo[dn])
+                continue
             di = self.dims.index(dn)
             lo, hi = region[di]
             o = offs.get(dn, 0)
@@ -299,16 +316,22 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 b -= 1
             block[d] = b
 
-    var_order = sorted(program.geoms)
+    var_order = [n for n in sorted(program.geoms)
+                 if not program.geoms[n].is_scratch]
     written = [n for n in var_order if program.geoms[n].is_written]
+    scratch_vars = [n for n in sorted(program.geoms)
+                    if program.geoms[n].is_scratch]
 
     # tile geometry per var (its own axes): leading dims it has are sized
-    # block+2hK; the minor dim (if present) is its full padded extent
+    # block+2hK; the minor dim (if present) is its full padded extent;
+    # misc axes ride whole
     def tile_shape(name):
         g = program.geoms[name]
         shp = []
-        for dn, kind in g.axes:
-            if dn == minor:
+        for i, (dn, kind) in enumerate(g.axes):
+            if kind == "misc":
+                shp.append(g.shape[i])
+            elif dn == minor:
                 pl_, pr_ = g.pads[minor]
                 shp.append(sizes[minor] + pl_ + pr_)
             else:
@@ -325,21 +348,21 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         slots[n] = nslots
         tile_bytes += nslots * int(
             math.prod(tile_shape(n))) * esize
-    # workspace for sub-step results (rough: one extra tile per written var)
+    # workspace for sub-step results (rough: one extra tile per written
+    # var) and the in-tile scratch values
     tile_bytes += sum(int(math.prod(tile_shape(n))) * esize for n in written)
+    tile_bytes += sum(int(math.prod(tile_shape(n))) * esize
+                      for n in scratch_vars)
     if tile_bytes > vmem_budget:
         raise YaskException(
             f"pallas tile needs {tile_bytes/2**20:.1f} MiB VMEM "
             f"(budget {vmem_budget/2**20:.0f}); shrink block or fuse_steps")
 
     grid = tuple(sizes[d] // block[d] for d in lead)
-    minor_origin = {n: (program.geoms[n].pads[minor][0]
-                        if minor in program.geoms[n].domain_dims else 0)
-                    for n in var_order}
+    minor_origin = {n: (g.pads[minor][0]
+                        if minor in g.domain_dims else 0)
+                    for n, g in program.geoms.items()}
     ev = _TileEval(jnp, program, minor, minor_origin)
-
-    stage_eqs = [[eq for part in st.parts for eq in part.eqs]
-                 for st in ana.stages]
 
     dirn = ana.step_dir
 
@@ -350,7 +373,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         #       scratch tiles ..., sem
         t0_ref = refs[0]
         ins = refs[1:n_inputs]
-        nout = sum(min(slots[n], 2) for n in written)
+        nout = sum(min(K, slots[n]) for n in written)
         outs = refs[n_inputs:n_inputs + nout]
         scratch = refs[n_inputs + nout:-1]
         sem = refs[-1]
@@ -366,8 +389,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 src = ins[si]
                 idxs = []
                 for dn, kind in g.axes:
-                    if dn == minor:
-                        idxs.append(slice(None))  # full padded extent
+                    if kind == "misc" or dn == minor:
+                        idxs.append(slice(None))  # full extent
                     else:
                         di = lead.index(dn)
                         start = (pid[di] * block[dn]
@@ -394,15 +417,48 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         # 2) K fused sub-steps; within each, every stage consumes its read
         #    radius of tile margin (trapezoid shrink) and writes a FULL
         #    tile (base.at[region].set) so later stages read it at offsets.
-        def region_idxs(name, region):
-            mo = program.geoms[name].pads[minor][0]
-            return tuple(slice(lo, hi) for lo, hi in region[:-1]) \
-                + (slice(mo + region[-1][0], mo + region[-1][1]),)
+        def region_idxs(name, region, misc=None):
+            """Index tuple over the var's own axes: domain axes sliced to
+            the region (minor shifted by the var's pad origin), misc axes
+            pinned to the LHS misc values (ints — they collapse, so the
+            result of base[idxs] is region-shaped)."""
+            g = program.geoms[name]
+            idxs = []
+            for dn, kind in g.axes:
+                if kind == "misc":
+                    idxs.append((misc or {})[dn] - g.misc_lo[dn])
+                elif dn == minor:
+                    mo = g.pads[minor][0]
+                    idxs.append(slice(mo + region[-1][0],
+                                      mo + region[-1][1]))
+                else:
+                    lo, hi = region[dims.index(dn)]
+                    idxs.append(slice(lo, hi))
+            return tuple(idxs)
+
+        def tile_update(base, idxs, val):
+            # dynamic_update_slice, NOT .at[].set: a full-tile static
+            # .at-set lowers to scatter whose empty i32 index array is a
+            # captured constant pallas_call rejects. Integer (misc) axes
+            # become size-1 update axes.
+            from jax import lax
+            starts = []
+            shape = []
+            for s in idxs:
+                if isinstance(s, slice):
+                    starts.append(s.start)
+                    shape.append(s.stop - s.start)
+                else:
+                    starts.append(s)
+                    shape.append(1)
+            return lax.dynamic_update_slice(
+                base, val.reshape(tuple(shape)), tuple(starts))
 
         ev.gidx_base = {d: pid[lead.index(d)] * block[d] - hK[d]
                         for d in lead}
         for k in range(K):
             computed: Dict[str, object] = {}
+            ev.scratch = {}   # scratch values are per-sub-step
             consumed = {d: rad[d] * k for d in lead}
             ev.t = t0_ref[0] + k * dirn
             for si_stage in range(nstages):
@@ -415,7 +471,6 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 # minor: interior-relative (per-var pad origin applied at
                 # read/write time); pads stay zero
                 region.append((0, sizes[minor]))
-                ev.region = region
                 rshape = tuple(hi - lo for lo, hi in region)
 
                 # global-domain mask over the region's leading dims
@@ -431,29 +486,67 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                     mask = m if mask is None else mask & m
 
                 memo: Dict = {}
-                for eq in stage_eqs[si_stage]:
-                    name = eq.lhs.var_name()
-                    val = ev.eval(eq.rhs, tiles, computed, memo)
-                    val = jnp.asarray(val, dtype=dtype)
-                    val = jnp.broadcast_to(val, rshape)
-                    base = computed.get(name, tiles[name][0])
-                    base_slice = base[region_idxs(name, region)]
-                    sel = mask
-                    if eq.cond is not None:
-                        cm = ev.eval(eq.cond, tiles, computed, memo)
-                        cm = jnp.broadcast_to(cm, rshape)
-                        sel = cm if sel is None else sel & cm
-                    if eq.step_cond is not None:
-                        sc = ev.eval(eq.step_cond, tiles, computed, memo)
-                        sc = jnp.broadcast_to(sc, rshape)
-                        sel = sc if sel is None else sel & sc
-                    # unselected points keep the base (evicted-slot /
-                    # earlier-write) values — ghosts there are zero, so
-                    # the zero-outside-domain invariant is preserved
-                    if sel is not None:
-                        val = jnp.where(sel, val, base_slice)
-                    computed[name] = base.at[region_idxs(name, region)] \
-                        .set(val)
+                for part in ana.stages[si_stage].parts:
+                    if part.is_scratch:
+                        # Scratch eqs evaluate over the stage region
+                        # EXPANDED by their write-halo (mirrors
+                        # _eval_part's scratch branch; stage_read_widths
+                        # already budgeted the margin for the chain) and
+                        # persist as full-tile values for offset reads.
+                        for eq in part.eqs:
+                            name = eq.lhs.var_name()
+                            wh = ana.scratch_write_halo.get(name, {})
+                            sregion = []
+                            for di, d in enumerate(lead):
+                                wl, wr = wh.get(d, (0, 0))
+                                lo, hi = region[di]
+                                sregion.append((lo - wl, hi + wr))
+                            wl_m, wr_m = wh.get(minor, (0, 0))
+                            sregion.append((-wl_m, sizes[minor] + wr_m))
+                            ev.region = sregion
+                            smemo: Dict = {}   # region differs: own memo
+                            val = ev.eval(eq.rhs, tiles, computed, smemo)
+                            val = jnp.asarray(val, dtype=dtype)
+                            srshape = tuple(hi - lo for lo, hi in sregion)
+                            val = jnp.broadcast_to(val, srshape)
+                            base = ev.scratch.get(
+                                name, jnp.zeros(tile_shape(name), dtype))
+                            sidx = region_idxs(name, sregion,
+                                               eq.lhs.misc_vals())
+                            if eq.cond is not None:
+                                cm = ev.eval(eq.cond, tiles, computed,
+                                             smemo)
+                                cm = jnp.broadcast_to(cm, srshape)
+                                val = jnp.where(cm, val, base[sidx])
+                            ev.scratch[name] = tile_update(base, sidx, val)
+                        continue
+
+                    ev.region = region
+                    for eq in part.eqs:
+                        name = eq.lhs.var_name()
+                        lmisc = eq.lhs.misc_vals()
+                        val = ev.eval(eq.rhs, tiles, computed, memo)
+                        val = jnp.asarray(val, dtype=dtype)
+                        val = jnp.broadcast_to(val, rshape)
+                        base = computed.get(name, tiles[name][0])
+                        base_slice = base[region_idxs(name, region, lmisc)]
+                        sel = mask
+                        if eq.cond is not None:
+                            cm = ev.eval(eq.cond, tiles, computed, memo)
+                            cm = jnp.broadcast_to(cm, rshape)
+                            sel = cm if sel is None else sel & cm
+                        if eq.step_cond is not None:
+                            sc = ev.eval(eq.step_cond, tiles, computed,
+                                         memo)
+                            sc = jnp.broadcast_to(sc, rshape)
+                            sel = sc if sel is None else sel & sc
+                        # unselected points keep the base (evicted-slot /
+                        # earlier-write) values — ghosts there are zero,
+                        # so the zero-outside-domain invariant holds
+                        if sel is not None:
+                            val = jnp.where(sel, val, base_slice)
+                        computed[name] = tile_update(
+                            base, region_idxs(name, region, lmisc), val)
 
             # rotate rings with the sub-step's outputs
             for name in written:
@@ -464,34 +557,60 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 else:
                     tiles[name] = [newest]
 
-        # 3) write final interior block(s).
+        # 3) write back the slots the K sub-steps actually produced (the
+        #    newest min(K, alloc)); untouched older slots merely shifted
+        #    and are rebuilt host-side from the existing padded inputs.
         oi = 0
         for name in written:
             g = program.geoms[name]
             ring = tiles[name]
-            keep = min(slots[name], 2)
-            for s in range(keep):
-                src = ring[len(ring) - keep + s]
+            nback = min(K, slots[name])
+            for s in range(nback):
+                src = ring[len(ring) - nback + s]
                 idxs = []
-                for d in lead:
-                    idxs.append(slice(hK[d], hK[d] + block[d]))
-                mlo = g.pads[minor][0]
-                idxs.append(slice(mlo, mlo + sizes[minor]))
+                for dn, kind in g.axes:
+                    if kind == "misc":
+                        idxs.append(slice(None))
+                    elif dn == minor:
+                        mlo = g.pads[minor][0]
+                        idxs.append(slice(mlo, mlo + sizes[minor]))
+                    else:
+                        idxs.append(slice(hK[dn], hK[dn] + block[dn]))
                 outs[oi][...] = src[tuple(idxs)]
                 oi += 1
 
     # ---- pallas_call assembly -------------------------------------------
 
+    def out_geometry(name):
+        """(full shape, block shape, index_map) over the var's own axes:
+        misc axes ride whole (index 0), lead axes follow the grid."""
+        g = program.geoms[name]
+        full, blk = [], []
+        kinds = []
+        for i, (dn, kind) in enumerate(g.axes):
+            if kind == "misc":
+                full.append(g.shape[i])
+                blk.append(g.shape[i])
+                kinds.append(None)
+            elif dn == minor:
+                full.append(sizes[minor])
+                blk.append(sizes[minor])
+                kinds.append(None)
+            else:
+                full.append(sizes[dn])
+                blk.append(block[dn])
+                kinds.append(lead.index(dn))
+        def index_map(*pid, _kinds=tuple(kinds)):
+            return tuple(0 if k is None else pid[k] for k in _kinds)
+        return tuple(full), tuple(blk), index_map
+
     out_shapes = []
     out_specs = []
     for name in written:
-        keep = min(slots[name], 2)
-        for _ in range(keep):
-            out_shapes.append(jax.ShapeDtypeStruct(
-                tuple(sizes[d] for d in dims), dtype))
-            out_specs.append(pl.BlockSpec(
-                tuple(block[d] for d in lead) + (sizes[minor],),
-                lambda *pid: tuple(pid) + (0,)))
+        full, blk, imap = out_geometry(name)
+        for _ in range(min(K, slots[name])):
+            out_shapes.append(jax.ShapeDtypeStruct(full, dtype))
+            out_specs.append(pl.BlockSpec(blk, imap))
 
     # input 0 is the step-index scalar in SMEM; the rest stay in HBM
     in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] \
@@ -522,21 +641,16 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         oi = 0
         for name in written:
             g = program.geoms[name]
-            keep = min(slots[name], 2)
-            ring = list(state[name])
-            pads = []
-            for d in dims:
-                pads.append(g.pads[d])
+            pads = [g.pads[dn] if kind == "domain" else (0, 0)
+                    for dn, kind in g.axes]
+            nback = min(K, slots[name])
             news = []
-            for s in range(keep):
+            for s in range(nback):
                 news.append(jnp.pad(outs[oi], pads))
                 oi += 1
-            # ring after K steps: oldest slots beyond `keep` are dropped
-            # (alloc ≤ 2 enforced), newest two replaced
-            if len(ring) == 1:
-                new_state[name] = [news[-1]]
-            else:
-                new_state[name] = news[-2:]
+            # ring after K steps = surviving (already padded) input slots
+            # shifted down, plus the newly produced ones
+            new_state[name] = list(state[name][nback:]) + news
         return new_state
 
     return chunk, tile_bytes

@@ -68,7 +68,14 @@ def plan_blocks(program, fuse_steps: int = 1,
     minor_ext = 1
     for n, g in program.geoms.items():
         slots = g.alloc if (g.has_step and g.is_written) else 1
-        nbuf += slots + (1 if g.is_written else 0)
+        # misc axes ride whole in every tile: they multiply the buffer
+        # count, or the VMEM estimate undershoots (box/gaussian channel
+        # dims) and the kernel's exact accounting rejects the plan
+        misc_ext = 1
+        for i, (dn, kind) in enumerate(g.axes):
+            if kind == "misc":
+                misc_ext *= g.shape[i]
+        nbuf += (slots + (1 if g.is_written else 0)) * misc_ext
         if minor in g.domain_dims:
             pl_, pr_ = g.pads[minor]
             minor_ext = max(minor_ext, sizes[minor] + pl_ + pr_)
