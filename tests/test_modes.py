@@ -226,3 +226,62 @@ def test_conditions_under_sharding(env):
     assert run("jit").compare_data(ref) == 0
     assert run("shard_map", [("x", 4)]).compare_data(ref) == 0
     assert run("sharded", [("x", 4)]).compare_data(ref) == 0
+
+
+# ---------------------------------------------------------------------------
+# shard_pallas: shard_map outer + fused Pallas inner (the multi-chip
+# scaling path — reference WF + exchange interplay, context.cpp:352-576)
+# ---------------------------------------------------------------------------
+
+
+def _run_sp(env, name, mode, wf=1, g=32, radius=2, ranks=None, steps=4):
+    from yask_tpu.runtime.init_utils import init_solution_vars
+    ctx = yk_factory().new_solution(env, stencil=name, radius=radius)
+    ctx.apply_command_line_options(f"-g {g}")
+    ctx.get_settings().mode = mode
+    ctx.get_settings().wf_steps = wf
+    for d, r in (ranks or []):
+        ctx.set_num_ranks(d, r)
+    ctx.prepare_solution()
+    init_solution_vars(ctx)
+    ctx.run_solution(0, steps - 1)
+    return ctx
+
+
+@pytest.mark.parametrize("wf,ranks", [
+    (1, [("x", 4)]),
+    (2, [("x", 4)]),
+    (2, [("x", 2), ("y", 2)]),
+    (3, [("x", 2), ("y", 4)]),   # K=3 exercises the remainder path (3+1)
+])
+def test_shard_pallas_iso3dfd_matches_oracle(env, wf, ranks):
+    ref = _run_sp(env, "iso3dfd", "ref")
+    sp = _run_sp(env, "iso3dfd", "shard_pallas", wf=wf, ranks=ranks)
+    assert sp.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+def test_shard_pallas_multi_stage_ssg(env):
+    ref = _run_sp(env, "ssg", "ref", steps=2)
+    for wf in (1, 2):
+        sp = _run_sp(env, "ssg", "shard_pallas", wf=wf, steps=2,
+                     ranks=[("x", 2), ("y", 2)])
+        assert sp.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+def test_shard_pallas_scratch_deep_ring_tti(env):
+    """tti: scratch chain + 3-slot ring through the distributed fused
+    path."""
+    ref = _run_sp(env, "tti", "ref", steps=2)
+    sp = _run_sp(env, "tti", "shard_pallas", wf=1, steps=2,
+                 ranks=[("x", 2)])
+    assert sp.compare_data(ref, epsilon=1e-2, abs_epsilon=1e-4) == 0
+
+
+def test_shard_pallas_rejects_minor_split_with_fusion(env):
+    from yask_tpu import YaskException
+    with pytest.raises(YaskException):
+        _run_sp(env, "iso3dfd", "shard_pallas", wf=2, ranks=[("z", 2)])
+    # K=1 minor split is legal (exchange every step, no in-tile staleness)
+    ref = _run_sp(env, "iso3dfd", "ref")
+    sp = _run_sp(env, "iso3dfd", "shard_pallas", wf=1, ranks=[("z", 2)])
+    assert sp.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0

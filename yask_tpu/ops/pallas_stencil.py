@@ -74,6 +74,27 @@ def pallas_applicable(csol) -> Tuple[bool, str]:
             if v.domain_dim_names() != ana.domain_dims:
                 return False, (f"written var '{v.get_name()}' must span "
                                "all domain dims")
+
+    # misc indices used as VALUES have no tile lowering — reject at
+    # prepare time with the fallback hint, not at first-run trace time
+    from yask_tpu.compiler.expr import ExprVisitor, IndexType
+
+    class _MiscValue(ExprVisitor):
+        found = False
+
+        def visit_index(self, node):
+            if node.type == IndexType.MISC:
+                self.found = True
+
+    mv = _MiscValue()
+    for eq in ana.eqs:
+        eq.rhs.accept(mv)
+        if eq.cond is not None:
+            eq.cond.accept(mv)
+        if eq.step_cond is not None:
+            eq.step_cond.accept(mv)
+    if mv.found:
+        return False, "uses a misc index as a value"
     return True, "ok"
 
 
@@ -110,12 +131,15 @@ class _TileEval:
 
     def global_index(self, d: str):
         """Global coordinate array for dim d over the current region,
-        broadcast-shaped."""
+        broadcast-shaped. ``gidx_base`` maps tile position 0 to the
+        global-problem coordinate (it includes the shard offset in
+        distributed mode)."""
         di = self.dims.index(d)
         lo, hi = self.region[di]
         ar = self.jnp.arange(lo, hi, dtype=self.jnp.int32)
-        if d != self.minor:
-            ar = ar + self.gidx_base[d]
+        base = self.gidx_base.get(d)
+        if base is not None:
+            ar = ar + base
         shape = [1] * len(self.dims)
         shape[di] = hi - lo
         return ar.reshape(shape)
@@ -245,13 +269,23 @@ class _TileEval:
 def build_pallas_chunk(program, fuse_steps: int = 1,
                        block: Optional[Tuple[int, ...]] = None,
                        interpret: bool = False,
-                       vmem_budget: int = 100 * 2 ** 20):
-    """Build ``chunk(state) -> state`` advancing ``fuse_steps`` steps in one
-    fused Pallas sweep.
+                       vmem_budget: int = 100 * 2 ** 20,
+                       distributed: bool = False):
+    """Build ``chunk(state, t0) -> state`` advancing ``fuse_steps`` steps
+    in one fused Pallas sweep.
 
     ``program`` must be planned with ``extra_pad`` ≥ the fused halo
     (radius × fuse_steps) in the leading dims — the runtime arranges this.
     Returns (chunk_fn, tile_bytes).
+
+    With ``distributed=True`` the chunk is the per-shard inner kernel of
+    the shard_map+pallas path: it takes a third argument ``offsets`` (an
+    i32 vector of this shard's global origin per domain dim, traced from
+    ``lax.axis_index``) and the zero-outside-domain mask uses GLOBAL
+    coordinates — so points in exchanged shard ghosts update through the
+    fused sub-steps while true physical boundaries stay zero. ``program``
+    must then be the per-shard plan built with ``global_sizes`` (its
+    ``global_last`` drives last_domain_index conditions).
     """
     import jax
     import jax.numpy as jnp
@@ -366,13 +400,19 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
 
     dirn = ana.step_dir
 
-    n_inputs = sum(slots[n] for n in var_order) + 1  # +1: t0 scalar
+    # global-problem extents for the zero-outside-domain mask; in
+    # distributed mode the shard's origin arrives as a traced vector
+    gdom = {d: program.global_last[d] + 1 for d in dims}
+    nscalars = 2 if distributed else 1  # t0 (+offsets)
+
+    n_inputs = sum(slots[n] for n in var_order) + nscalars
 
     def kernel(*refs):
-        # refs: t0 (SMEM), inputs (ANY/HBM) ..., outputs (VMEM blocks),
-        #       scratch tiles ..., sem
+        # refs: t0 (SMEM), [offsets (SMEM)], inputs (ANY/HBM) ...,
+        #       outputs (VMEM blocks), scratch tiles ..., sem
         t0_ref = refs[0]
-        ins = refs[1:n_inputs]
+        off_ref = refs[1] if distributed else None
+        ins = refs[nscalars:n_inputs]
         nout = sum(min(K, slots[n]) for n in written)
         outs = refs[n_inputs:n_inputs + nout]
         scratch = refs[n_inputs + nout:-1]
@@ -456,6 +496,9 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
 
         ev.gidx_base = {d: pid[lead.index(d)] * block[d] - hK[d]
                         for d in lead}
+        if distributed:
+            for di, d in enumerate(dims):
+                ev.gidx_base[d] = ev.gidx_base.get(d, 0) + off_ref[di]
         for k in range(K):
             computed: Dict[str, object] = {}
             ev.scratch = {}   # scratch values are per-sub-step
@@ -473,13 +516,21 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 region.append((0, sizes[minor]))
                 rshape = tuple(hi - lo for lo, hi in region)
 
-                # global-domain mask over the region's leading dims
+                # global-domain mask over the region's leading dims: in
+                # distributed mode bounds are the GLOBAL problem, so
+                # shard-ghost points keep updating while physical edges
+                # stay zero
                 mask = None
                 for di, d in enumerate(lead):
                     lo, hi = region[di]
                     gidx = (jnp.arange(lo, hi)
                             + pid[di] * block[d] - hK[d])
-                    m = (gidx >= 0) & (gidx < sizes[d])
+                    if distributed:
+                        gidx = gidx + off_ref[di]
+                        bound = gdom[d]
+                    else:
+                        bound = sizes[d]
+                    m = (gidx >= 0) & (gidx < bound)
                     shape = [1] * len(dims)
                     shape[di] = hi - lo
                     m = m.reshape(shape)
@@ -612,14 +663,14 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             out_shapes.append(jax.ShapeDtypeStruct(full, dtype))
             out_specs.append(pl.BlockSpec(blk, imap))
 
-    # input 0 is the step-index scalar in SMEM; the rest stay in HBM
-    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] \
-        + [pl.BlockSpec(memory_space=pl.ANY)] * (n_inputs - 1)
+    # leading scalars (step index, shard offsets) ride SMEM; arrays HBM
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] * nscalars \
+        + [pl.BlockSpec(memory_space=pl.ANY)] * (n_inputs - nscalars)
     scratch_shapes = []
     for n in var_order:
         for _ in range(slots[n]):
             scratch_shapes.append(pltpu.VMEM(tile_shape(n), dtype))
-    scratch_shapes.append(pltpu.SemaphoreType.DMA((n_inputs - 1,)))
+    scratch_shapes.append(pltpu.SemaphoreType.DMA((n_inputs - nscalars,)))
 
     call = pl.pallas_call(
         kernel,
@@ -631,8 +682,10 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         interpret=interpret,
     )
 
-    def chunk(state, t0):
+    def chunk(state, t0, offsets=None):
         flat = [jnp.asarray(t0, dtype=jnp.int32).reshape(1)]
+        if distributed:
+            flat.append(jnp.asarray(offsets, dtype=jnp.int32))
         for n in var_order:
             for a in state[n]:
                 flat.append(a.reshape(1) if a.ndim == 0 else a)

@@ -203,6 +203,57 @@ def _make_overlap_step(prog, nr, lsizes, exchange=exchange_ghosts):
     return one_step
 
 
+def _make_specs_for(local_prog, nr):
+    """PartitionSpec builder: domain axes with >1 rank follow the mesh."""
+    from jax.sharding import PartitionSpec
+
+    def specs_for(name):
+        g = local_prog.geoms[name]
+        spec = []
+        for dn, kind in g.axes:
+            spec.append(dn if (kind == "domain" and nr.get(dn, 1) > 1)
+                        else None)
+        return PartitionSpec(*spec)
+    return specs_for
+
+
+def _strip_global_interiors(ctx, gprog, names, mesh, specs_for, gsizes):
+    """Global padded state → sharded interior blocks. Pads are
+    identically zero (framework invariant), so stripping and
+    re-attaching are pure device ops — no host round trip."""
+    import jax
+    from jax.sharding import NamedSharding
+    interior = {}
+    for k in names:
+        g = gprog.geoms[k]
+        idxs = []
+        for dn, kind in g.axes:
+            if kind == "domain":
+                idxs.append(slice(g.origin[dn], g.origin[dn] + gsizes[dn]))
+            else:
+                idxs.append(slice(None))
+        sh = NamedSharding(mesh, specs_for(k))
+        interior[k] = [jax.device_put(a[tuple(idxs)], sh)
+                       for a in ctx._state[k]]
+    return interior
+
+
+def _repad_global(gprog, names, out):
+    """Re-attach the (zero) global pads on device."""
+    import jax.numpy as jnp
+    new_state = {}
+    for k in names:
+        g = gprog.geoms[k]
+        pads = []
+        for dn, kind in g.axes:
+            pads.append(g.pads[dn] if kind == "domain" else (0, 0))
+        ring = []
+        for res in out[k]:
+            ring.append(jnp.pad(res, pads) if pads else res)
+        new_state[k] = ring
+    return new_state
+
+
 def run_shard_map(ctx, start: int, n: int) -> None:
     """Advance ``n`` steps in explicit shard_map mode, updating
     ``ctx._state`` (global padded arrays) in place."""
@@ -226,14 +277,7 @@ def run_shard_map(ctx, start: int, n: int) -> None:
 
     names = [k for k in ctx._state.keys()]
     slots = {k: len(ctx._state[k]) for k in names}
-
-    def specs_for(name):
-        g = local_prog.geoms[name]
-        spec = []
-        for dn, kind in g.axes:
-            spec.append(dn if (kind == "domain" and nr.get(dn, 1) > 1)
-                        else None)
-        return PartitionSpec(*spec)
+    specs_for = _make_specs_for(local_prog, nr)
 
     # overlap_comms is captured at trace time, so it must key the cache —
     # otherwise toggling it between equal-length runs silently reuses the
@@ -366,18 +410,8 @@ def run_shard_map(ctx, start: int, n: int) -> None:
     # The run timer covers strip + program + re-pad (the per-call work
     # every mode pays); only halo calibration is excluded, like compile.
     t0r = time.perf_counter()
-    interior = {}
-    for k in names:
-        g = gprog.geoms[k]
-        idxs = []
-        for dn, kind in g.axes:
-            if kind == "domain":
-                idxs.append(slice(g.origin[dn], g.origin[dn] + gsizes[dn]))
-            else:
-                idxs.append(slice(None))
-        sh = NamedSharding(mesh, specs_for(k))
-        interior[k] = [jax.device_put(a[tuple(idxs)], sh)
-                       for a in ctx._state[k]]
+    interior = _strip_global_interiors(ctx, gprog, names, mesh,
+                                       specs_for, gsizes)
 
     # Halo-time calibration (once per compiled variant): time the real
     # program against its no-exchange twin on copies of the interiors;
@@ -423,20 +457,197 @@ def run_shard_map(ctx, start: int, n: int) -> None:
     jax.block_until_ready(out)
     dt_call = time.perf_counter() - t0c2
 
-    # Re-attach the (zero) pads on device.
-    new_state = {}
-    for k in names:
-        g = gprog.geoms[k]
-        pads = []
-        for dn, kind in g.axes:
-            pads.append(g.pads[dn] if kind == "domain" else (0, 0))
-        ring = []
-        for res in out[k]:
-            ring.append(jnp.pad(res, pads) if pads else res)
-        new_state[k] = ring
-    ctx._state = new_state
+    ctx._state = _repad_global(gprog, names, out)
 
     # Elapsed = strip + program + re-pad, minus the one-off calibration;
     # the halo fraction applies to the program window it was measured on.
     ctx._run_timer._elapsed += time.perf_counter() - t0r - cal_secs
     ctx._halo_timer._elapsed += frac * dt_call
+
+
+def run_shard_pallas(ctx, start: int, n: int) -> None:
+    """Distributed fused stepping: shard_map outer + Pallas inner.
+
+    The scaling path for the flagship multi-chip target (reference
+    wave-front + MPI-exchange interplay, ``context.cpp:352-576``): each
+    shard carries ghost pads sized radius×K, ``lax.ppermute`` refreshes
+    them once per K-step group, and the fused Pallas chunk advances K
+    steps entirely on-shard (its domain mask works in global coordinates
+    via the shard offset, so exchanged ghosts update through sub-steps
+    while physical boundaries stay zero).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from yask_tpu.ops.pallas_stencil import build_pallas_chunk
+
+    opts = ctx._opts
+    ana = ctx._ana
+    mesh = ctx._mesh
+    dims = ana.domain_dims
+    minor = dims[-1]
+    nr = {d: opts.num_ranks[d] for d in dims}
+    gsizes = opts.global_domain_sizes
+    lsizes = opts.rank_domain_sizes
+    dirn = ana.step_dir
+
+    K = min(max(opts.wf_steps, 1), n)
+    if K > 1 and nr.get(minor, 1) > 1:
+        raise YaskException(
+            f"shard_pallas with wf_steps={K} > 1 cannot shard the minor "
+            f"dim '{minor}' (its in-tile region never shrinks); use "
+            "wf_steps 1 or keep the minor dim whole")
+    rad = ana.fused_step_radius()
+    hK = {d: rad.get(d, 0) * K for d in dims}
+    for d in dims:
+        if nr.get(d, 1) > 1 and lsizes[d] < hK[d]:
+            raise YaskException(
+                f"rank domain {lsizes[d]} in dim '{d}' smaller than the "
+                f"fused ghost width {hK[d]} (radius × wf_steps)")
+
+    # Per-shard plan: pads grown to the fused ghost width so the kernel's
+    # halo DMAs stay inside the array and exchanges have room.
+    extra = {d: (hK[d], hK[d]) for d in dims}
+    local_prog = ctx._csol.plan(lsizes, global_sizes=gsizes,
+                                extra_pad=extra)
+    gprog = ctx._program
+
+    names = [k for k in ctx._state.keys()]
+    slots = {k: len(ctx._state[k]) for k in names}
+    specs_for = _make_specs_for(local_prog, nr)
+
+    bs = opts.block_sizes
+    blk = None
+    if any(bs[d] > 0 for d in dims[:-1]):
+        blk = tuple(bs[d] if bs[d] > 0 else 8 for d in dims[:-1])
+    groups, rem = divmod(n, K)
+    key = ("shard_pallas", n, K, blk)
+
+    if key not in ctx._jit_cache:
+        interp = ctx._env.get_platform() != "tpu"
+        chunk, tile_bytes = build_pallas_chunk(
+            local_prog, fuse_steps=K, block=blk, interpret=interp,
+            distributed=True)
+        chunk_rem = None
+        if rem:
+            chunk_rem, _ = build_pallas_chunk(
+                local_prog, fuse_steps=rem, block=blk, interpret=interp,
+                distributed=True)
+        ctx._env.trace_msg(
+            f"shard_pallas chunk: K={K}, blocks={blk or 'planner'}, "
+            f"tile {tile_bytes / 2**20:.2f} MiB")
+        shard_map = _shard_map_fn()
+
+        in_specs = ({k: [specs_for(k)] * slots[k] for k in names},
+                    PartitionSpec())
+        out_specs = {k: [specs_for(k)] * slots[k] for k in names}
+
+        def _widths(g):
+            return {d: (hK[d], hK[d]) for d in g.domain_dims
+                    if nr.get(d, 1) > 1 and hK[d] > 0}
+
+        def exchange_all(state):
+            """Full refresh: every slot of every var (run once up front —
+            read-only vars and surviving ring slots keep valid ghosts
+            after this)."""
+            for k in names:
+                g = local_prog.geoms[k]
+                widths = _widths(g)
+                if widths:
+                    state = {**state,
+                             k: [exchange_ghosts(a, g, widths, nr, lsizes)
+                                 for a in state[k]]}
+            return state
+
+        def exchange_newest(state):
+            """Per-group refresh: only the min(K, alloc) slots the chunk
+            just produced (it re-zeroed their pads); everything else
+            still holds valid ghosts."""
+            for k in names:
+                g = local_prog.geoms[k]
+                if not g.is_written:
+                    continue
+                widths = _widths(g)
+                if not widths:
+                    continue
+                ring = list(state[k])
+                nback = min(K, len(ring))
+                for i in range(len(ring) - nback, len(ring)):
+                    ring[i] = exchange_ghosts(ring[i], g, widths, nr,
+                                              lsizes)
+                state = {**state, k: ring}
+            return state
+
+        def body(interior_state, t0):
+            offs = {d: lax.axis_index(d) * lsizes[d] if nr[d] > 1 else 0
+                    for d in dims}
+            off_vec = jnp.stack(
+                [jnp.asarray(offs[d], dtype=jnp.int32) for d in dims])
+
+            # 1) pad local interiors (ghost + physical zeros).
+            state = {}
+            for k in names:
+                g = local_prog.geoms[k]
+                pads = [(g.pads[dn] if kind == "domain" else (0, 0))
+                        for dn, kind in g.axes]
+                state[k] = [jnp.pad(a, pads) if pads else a
+                            for a in interior_state[k]]
+
+            # 2) one full exchange up front, then per K-group the fused
+            #    chunk runs and only its freshly produced slots (whose
+            #    pads it re-zeroed) are re-exchanged — read-only vars and
+            #    surviving slots never move again.
+            state = exchange_all(state)
+
+            def group(carry, _):
+                st, t = carry
+                st = chunk(st, t, off_vec)
+                st = exchange_newest(st)
+                return (st, t + K * dirn), None
+
+            (state, t), _ = lax.scan(group, (state, t0), None,
+                                     length=groups)
+            if rem:
+                state = chunk_rem(state, t, off_vec)
+
+            # 3) strip pads.
+            out = {}
+            for k in names:
+                g = local_prog.geoms[k]
+                idxs = []
+                for dn, kind in g.axes:
+                    if kind == "domain":
+                        idxs.append(slice(g.origin[dn],
+                                          g.origin[dn] + lsizes[dn]))
+                    else:
+                        idxs.append(slice(None))
+                out[k] = [a[tuple(idxs)] for a in state[k]]
+            return out
+
+        try:
+            mapped = shard_map(body, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_vma=False)
+        except TypeError:  # older jax spells it check_rep
+            mapped = shard_map(body, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_rep=False)
+        # AOT-compile with the real interiors so the first timed call
+        # doesn't include XLA/Mosaic compilation (same policy as the
+        # single-device pallas path).
+        interior = _strip_global_interiors(ctx, gprog, names, mesh,
+                                           specs_for, gsizes)
+        t0c = time.perf_counter()
+        ctx._jit_cache[key] = jax.jit(mapped, donate_argnums=0) \
+            .lower(interior, jnp.asarray(start, dtype=jnp.int32)).compile()
+        ctx._compile_secs += time.perf_counter() - t0c
+    fn = ctx._jit_cache[key]
+
+    # Strip global pads → sharded interiors, run, re-pad (device-side,
+    # pads are zero by invariant). Same accounting as run_shard_map.
+    t0r = time.perf_counter()
+    interior = _strip_global_interiors(ctx, gprog, names, mesh,
+                                       specs_for, gsizes)
+    out = fn(interior, jnp.asarray(start, dtype=jnp.int32))
+    jax.block_until_ready(out)
+    ctx._state = _repad_global(gprog, names, out)
+    ctx._run_timer._elapsed += time.perf_counter() - t0r

@@ -236,9 +236,16 @@ class StencilContext:
                  for d in self._ana.domain_dims}
         gsizes = self._opts.global_domain_sizes
 
-        if mode == "shard_map":
+        if mode in ("shard_map", "shard_pallas"):
             from yask_tpu.parallel.decomp import validate_shard_geometry
             validate_shard_geometry(self._csol, self._opts)
+        if mode == "shard_pallas":
+            from yask_tpu.ops.pallas_stencil import pallas_applicable
+            ok, why = pallas_applicable(self._csol)
+            if not ok:
+                raise YaskException(
+                    f"solution '{self.get_name()}' cannot use the "
+                    f"shard_pallas path: {why}; use -mode shard_map")
 
         # Compute geometry is always the *global* problem; the shard_map
         # path re-plans per-shard geometry inside the mapped region.
@@ -269,7 +276,7 @@ class StencilContext:
         self._state = self._program.alloc_state()
         self._state_on_device = True
 
-        if mode in ("sharded", "shard_map"):
+        if mode in ("sharded", "shard_map", "shard_pallas"):
             from yask_tpu.parallel.mesh import build_mesh, state_shardings
             self._mesh = build_mesh(self._env, self._opts)
             if mode == "sharded":
@@ -411,6 +418,10 @@ class StencilContext:
             # run_shard_map does its own timer accounting: halo
             # calibration and twin compiles must stay out of elapsed.
             run_shard_map(self, start, n)
+        elif self._mode == "shard_pallas":
+            from yask_tpu.parallel.shard_step import run_shard_pallas
+            self._state_to_device()
+            run_shard_pallas(self, start, n)
         else:
             self._run_jit_steps(start, n)
 

@@ -28,6 +28,8 @@ MODES = ("auto",       # single device → "jit"; >1 rank requested → "sharded
          "pallas",     # hand-tiled Pallas kernels w/ K-step temporal fusion
          "sharded",    # global arrays + NamedSharding (XLA inserts comms)
          "shard_map",  # explicit per-shard program + ppermute halo exchange
+         "shard_pallas",  # shard_map outer + fused Pallas inner (the
+         #                  multi-chip scaling path: exchange every K steps)
          "ref",        # eager numpy oracle (the reference's run_ref)
          )
 
@@ -135,7 +137,7 @@ class KernelSettings:
             for d in self.domain_dims:
                 nr[d] = auto[d]
         elif all(v == 0 for v in nr.get_vals()) and num_devices > 1 \
-                and self.mode in ("sharded", "shard_map"):
+                and self.mode in ("sharded", "shard_map", "shard_pallas"):
             # Distribution requested by mode but no grid given: split the
             # outer-most dim so halo slabs stay lane-contiguous.
             for d in self.domain_dims:
