@@ -597,7 +597,8 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
             # 2) one full exchange up front, then per K-group the fused
             #    chunk runs and only its freshly produced slots (whose
             #    pads it re-zeroed) are re-exchanged — read-only vars and
-            #    surviving slots never move again.
+            #    surviving slots never move again. The final chunk is
+            #    unrolled so no exchange is wasted after the last group.
             state = exchange_all(state)
 
             def group(carry, _):
@@ -606,10 +607,13 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
                 st = exchange_newest(st)
                 return (st, t + K * dirn), None
 
+            nscan = groups if rem else groups - 1
             (state, t), _ = lax.scan(group, (state, t0), None,
-                                     length=groups)
+                                     length=nscan)
             if rem:
                 state = chunk_rem(state, t, off_vec)
+            else:
+                state = chunk(state, t, off_vec)
 
             # 3) strip pads.
             out = {}
@@ -631,22 +635,27 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
         except TypeError:  # older jax spells it check_rep
             mapped = shard_map(body, mesh=mesh, in_specs=in_specs,
                                out_specs=out_specs, check_rep=False)
-        # AOT-compile with the real interiors so the first timed call
-        # doesn't include XLA/Mosaic compilation (same policy as the
-        # single-device pallas path).
-        interior = _strip_global_interiors(ctx, gprog, names, mesh,
-                                           specs_for, gsizes)
-        t0c = time.perf_counter()
-        ctx._jit_cache[key] = jax.jit(mapped, donate_argnums=0) \
-            .lower(interior, jnp.asarray(start, dtype=jnp.int32)).compile()
-        ctx._compile_secs += time.perf_counter() - t0c
-    fn = ctx._jit_cache[key]
+    else:
+        mapped = None
 
     # Strip global pads → sharded interiors, run, re-pad (device-side,
-    # pads are zero by invariant). Same accounting as run_shard_map.
+    # pads are zero by invariant). Same accounting as run_shard_map; the
+    # stripped interiors serve both AOT lowering (first call) and the
+    # run, and compile time is excluded from the run window.
     t0r = time.perf_counter()
     interior = _strip_global_interiors(ctx, gprog, names, mesh,
                                        specs_for, gsizes)
+    if mapped is not None:
+        # AOT-compile so the first timed call doesn't include XLA/Mosaic
+        # compilation (same policy as the single-device pallas path).
+        t0c = time.perf_counter()
+        ctx._jit_cache[key] = jax.jit(mapped, donate_argnums=0) \
+            .lower(interior, jnp.asarray(start, dtype=jnp.int32)).compile()
+        dtc = time.perf_counter() - t0c
+        ctx._compile_secs += dtc
+        t0r += dtc
+    fn = ctx._jit_cache[key]
+
     out = fn(interior, jnp.asarray(start, dtype=jnp.int32))
     jax.block_until_ready(out)
     ctx._state = _repad_global(gprog, names, out)

@@ -1,18 +1,20 @@
 """Online auto-tuner.
 
 Counterpart of the reference's ``AutoTuner``
-(``src/kernel/lib/auto_tuner.hpp:31-132``, ``auto_tuner.cpp:206``): a greedy
-search over the tunable execution parameters, evaluated by timing *real*
-solution steps that count toward the run (the reference folds trials into the
-production run the same way), with a perf cache keyed by the candidate tuple
-and early abandonment of slower candidates.
+(``src/kernel/lib/auto_tuner.hpp:31-132``, ``auto_tuner.cpp:206``): a
+greedy neighborhood walk over the tunable execution parameters, with a
+perf cache keyed by the candidate tuple and early abandonment of slower
+candidates mid-trial.
 
-On TPU the search space is not OpenMP block sizes but the **steps fused per
-compiled chunk** (``wf_steps`` — the temporal-tiling analog: longer chunks
-amortize dispatch and let XLA overlap across steps, at the cost of compile
-time) and, when the Pallas backend is active, its block shapes. Each
-candidate implies one XLA compilation, cached by tuple exactly as the
-reference caches per-size results (``auto_tuner.hpp:65``).
+On TPU the search space is the **steps fused per compiled chunk**
+(``wf_steps`` — the temporal-tiling analog: longer chunks amortize
+dispatch and let XLA overlap across steps, at the cost of compile time)
+and, when the Pallas backend is active, its **leading-dim block shapes**
+(the vector-fold/block analog) — searched jointly: from the planner's
+starting point, each move doubles or halves one knob (the reference's
+power-of-two radius walk), moving while any neighbor improves. Each
+candidate implies one XLA/Mosaic compilation, cached by tuple exactly as
+the reference caches per-size results (``auto_tuner.hpp:65``).
 """
 
 from __future__ import annotations
@@ -22,8 +24,7 @@ from typing import Dict, List, Optional, Tuple
 
 
 class AutoTuner:
-    #: chunk-length candidates (powers of two, like the reference's
-    #: power-of-two radius shrinking walk).
+    #: chunk-length candidates for the K-only sweep (jit/sharded modes).
     CHUNK_CANDIDATES = (1, 2, 4, 8, 16, 32)
 
     def __init__(self, ctx):
@@ -39,9 +40,10 @@ class AutoTuner:
 
     def run_auto_tuner_now(self, candidates: Optional[List[int]] = None,
                            min_trial_secs: Optional[float] = None) -> int:
-        """Time each chunk-length candidate, pick the best, and record it
-        in ``settings.wf_steps`` (the API twin of
-        ``yk_solution::run_auto_tuner_now``, ``yk_solution_api.hpp:881``).
+        """Search the candidate space, pick the best, and record it in
+        the settings (the API twin of ``yk_solution::run_auto_tuner_now``,
+        ``yk_solution_api.hpp:881``). jit/sharded modes sweep chunk
+        lengths; the pallas mode walks (K, block-shape) jointly.
 
         Trials run on a *copy* of the solution state and are discarded:
         unlike the reference (which folds trial steps into the production
@@ -49,14 +51,11 @@ class AutoTuner:
         stencils, so the production run re-executes its full range with
         the tuned settings and the stats/timers only ever see real steps.
         The compiled chunks are cached, so trial compilation is reused."""
-        import jax
         import jax.numpy as jnp
         ctx = self.ctx
-        cands = list(candidates or self.CHUNK_CANDIDATES)
-        trial_secs = (min_trial_secs if min_trial_secs is not None
-                      else ctx._opts.auto_tune_trial_secs)
-        dirn = ctx._ana.step_dir
-        use_pallas = ctx._mode == "pallas"
+        self.trial_secs = (min_trial_secs if min_trial_secs is not None
+                           else ctx._opts.auto_tune_trial_secs)
+        self.best_rate: Optional[float] = None
 
         ctx._state_to_device()
         saved_state = ctx._state
@@ -66,66 +65,160 @@ class AutoTuner:
         ctx._state = {k: [jnp.copy(a) for a in ring]
                       for k, ring in saved_state.items()}
         try:
-            return self._trial_loop(jax, ctx, cands, trial_secs,
-                                    dirn, use_pallas)
+            if ctx._mode == "pallas" and candidates is None:
+                return self._walk_joint()
+            return self._sweep_k(candidates)
         finally:
             ctx._state = saved_state
             ctx._cur_step, ctx._steps_done = saved_cur, saved_done
 
-    def _trial_loop(self, jax, ctx, cands, trial_secs,
-                    dirn, use_pallas) -> int:
-        best_key, best_rate = None, None
-        for k in cands:
-            key = (k,)
-            if use_pallas:
-                try:
-                    pfn = ctx._get_pallas_chunk(k)
-                except Exception:
-                    continue  # tile wouldn't fit VMEM etc.
-                compiled = pfn
-            else:
-                compiled = ctx._get_compiled_chunk(k)
-            # warmup call (not timed — excludes dispatch jitter)
+    # ------------------------------------------------------------------
+
+    def _measure(self, key: Tuple, make_compiled) -> float:
+        """Timed trial of one candidate (cached): secs/step, or inf when
+        the candidate cannot compile (e.g. tile over the VMEM budget).
+        A candidate clearly slower than the best is abandoned mid-trial
+        (the reference's eval cutoff, ``auto_tuner.cpp:206`` region)."""
+        import jax
+        if key in self.results:
+            return self.results[key]
+        ctx = self.ctx
+        k = key[0]
+        dirn = ctx._ana.step_dir
+        from yask_tpu.utils.exceptions import YaskException
+        try:
+            compiled = make_compiled()
+        except YaskException:
+            # infeasible candidate (tile over the VMEM budget, fusion
+            # beyond planned pads) — skip it; real compile errors raise
+            self.results[key] = float("inf")
+            return float("inf")
+        # warmup call (not timed — excludes dispatch jitter)
+        st = compiled(ctx._state, ctx._cur_step)
+        jax.block_until_ready(st)
+        ctx._state = st
+        ctx._cur_step += k * dirn
+        calls = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < self.trial_secs:
             st = compiled(ctx._state, ctx._cur_step)
             jax.block_until_ready(st)
             ctx._state = st
             ctx._cur_step += k * dirn
-            ctx._steps_done += k
-            # timed calls until the trial budget is spent, abandoning the
-            # candidate mid-trial once it is clearly slower than the best
-            # (the reference's eval cutoff, auto_tuner.cpp:206 region)
-            calls = 0
-            t0 = time.perf_counter()
-            while time.perf_counter() - t0 < trial_secs:
-                st = compiled(ctx._state, ctx._cur_step)
-                jax.block_until_ready(st)
-                ctx._state = st
-                ctx._cur_step += k * dirn
-                ctx._steps_done += k
-                calls += 1
-                if best_rate is not None and \
-                        (time.perf_counter() - t0) / (calls * k) \
-                        > 2.0 * best_rate:
-                    break
-            elapsed = time.perf_counter() - t0
-            per_step = elapsed / max(calls * k, 1)
-            self.results[key] = per_step
-            if best_rate is None or per_step < best_rate:
-                best_rate, best_key = per_step, key
+            calls += 1
+            if self.best_rate is not None and \
+                    (time.perf_counter() - t0) / (calls * k) \
+                    > 2.0 * self.best_rate:
+                break
+        per_step = (time.perf_counter() - t0) / max(calls * k, 1)
+        self.results[key] = per_step
+        if self.best_rate is None or per_step < self.best_rate:
+            self.best_rate = per_step
+        return per_step
+
+    def _sweep_k(self, candidates: Optional[List[int]]) -> int:
+        """Chunk-length sweep (jit/sharded, or an explicit K list)."""
+        ctx = self.ctx
+        use_pallas = ctx._mode == "pallas"
+        best_key, best = None, None
+        for k in list(candidates or self.CHUNK_CANDIDATES):
+            if use_pallas:
+                mk = (lambda k=k: ctx._get_pallas_chunk(k))
+            else:
+                mk = (lambda k=k: ctx._get_compiled_chunk(k))
+            r = self._measure((k,), mk)
+            if r != float("inf") and (best is None or r < best):
+                best_key, best = (k,), r
         ctx._tuned = True
         if best_key is None:
-            # every candidate infeasible (e.g. pallas tiles over the VMEM
-            # budget): keep current settings rather than crash the run
             ctx._env.trace_msg("auto-tuner: no feasible candidates; "
                                "keeping current settings")
             return ctx._opts.wf_steps
         ctx._opts.wf_steps = best_key[0]
         ctx._env.trace_msg(
-            f"auto-tuner: wf_steps={best_key[0]} "
-            f"({best_rate * 1e3:.3f} ms/step)")
+            f"auto-tuner: wf_steps={best_key[0]} ({best * 1e3:.3f} ms/step)")
         return best_key[0]
+
+    def _walk_joint(self) -> int:
+        """Greedy (K, block-shape) neighborhood walk for the pallas path:
+        start from the planner's choice, try doubling/halving each knob,
+        move while something improves (the reference's shrinking-
+        neighborhood walk over all block-level sizes)."""
+        from yask_tpu.ops.tile_planner import plan_blocks
+        ctx = self.ctx
+        lead = ctx._ana.domain_dims[:-1]
+        sizes = {d: ctx._program.sizes[d] for d in lead}
+
+        def fit(d, b):
+            b = max(1, min(b, sizes[d]))
+            while sizes[d] % b != 0:
+                b -= 1
+            return b
+
+        k0 = max(ctx._opts.wf_steps, 1)
+        bs = ctx._opts.block_sizes
+        if any(bs[d] > 0 for d in lead):
+            blk0 = tuple(fit(d, bs[d] if bs[d] > 0 else 8) for d in lead)
+        else:
+            planned = plan_blocks(ctx._program, fuse_steps=k0)
+            blk0 = tuple(planned[d] for d in lead)
+
+        def measure(cand):
+            k, blk = cand
+
+            def mk():
+                old = {d: bs[d] for d in lead}
+                for d, b in zip(lead, blk):
+                    bs[d] = b
+                try:
+                    return ctx._get_pallas_chunk(k)
+                finally:
+                    for d in lead:
+                        bs[d] = old[d]
+            return self._measure((k, blk), mk)
+
+        cur = (k0, blk0)
+        cur_rate = measure(cur)
+        moved = True
+        while moved:
+            moved = False
+            k, blk = cur
+            neighbors = []
+            for nk in (k * 2, k // 2):
+                if nk >= 1:
+                    neighbors.append((nk, blk))
+            for i, d in enumerate(lead):
+                for nb in (fit(d, blk[i] * 2), fit(d, blk[i] // 2)):
+                    if nb != blk[i]:
+                        neighbors.append(
+                            (k, blk[:i] + (nb,) + blk[i + 1:]))
+            for cand in neighbors:
+                r = measure(cand)
+                if r < cur_rate:
+                    cur, cur_rate = cand, r
+                    moved = True
+            # moved → walk again from the new best point
+
+        ctx._tuned = True
+        if cur_rate == float("inf"):
+            ctx._env.trace_msg("auto-tuner: no feasible candidates; "
+                               "keeping current settings")
+            return ctx._opts.wf_steps
+        k, blk = cur
+        ctx._opts.wf_steps = k
+        for d, b in zip(lead, blk):
+            ctx._opts.block_sizes[d] = b
+        ctx._env.trace_msg(
+            f"auto-tuner: wf_steps={k}, blocks={dict(zip(lead, blk))} "
+            f"({cur_rate * 1e3:.3f} ms/step, {len(self.results)} "
+            "candidates tried)")
+        return k
 
     def apply_best(self) -> None:
         if self.results:
             best = min(self.results, key=self.results.get)
             self.ctx._opts.wf_steps = best[0]
+            if len(best) > 1:   # joint (k, block-shape) result
+                lead = self.ctx._ana.domain_dims[:-1]
+                for d, b in zip(lead, best[1]):
+                    self.ctx._opts.block_sizes[d] = b
