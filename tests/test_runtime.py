@@ -238,3 +238,39 @@ def test_halo_time_measured(env):
     before = ctx.get_stats().get_halo_secs()
     ctx.run_solution(8, 15)
     assert ctx.get_stats().get_halo_secs() > before
+
+
+def test_shard_state_stays_device_resident(env):
+    """Repeated shard-mode runs hand interiors over directly — no
+    per-call strip/re-pad (VERDICT r1 item 9); host var access
+    materializes lazily and stays correct."""
+    def build(mode):
+        ctx = yk_factory().new_solution(env, stencil="3axis", radius=1)
+        ctx.apply_command_line_options("-g 32")
+        ctx.get_settings().mode = mode
+        ctx.set_num_ranks("x", 4)
+        ctx.prepare_solution()
+        ctx.get_var("A").set_elements_in_seq(0.1)
+        return ctx
+
+    for mode in ("shard_map", "shard_pallas"):
+        ctx = build(mode)
+        ctx.run_solution(0, 1)
+        # interiors parked on device, padded state not rebuilt
+        assert ctx._resident is not None and ctx._state is None
+        ctx.run_solution(2, 3)   # second run consumes the resident set
+        assert ctx._resident is not None
+
+        oracle = yk_factory().new_solution(env, stencil="3axis", radius=1)
+        oracle.apply_command_line_options("-g 32")
+        oracle.get_settings().force_scalar = True
+        oracle.prepare_solution()
+        oracle.get_var("A").set_elements_in_seq(0.1)
+        oracle.run_solution(0, 3)
+        # compare_data materializes the resident interiors lazily
+        assert ctx.compare_data(
+            oracle, epsilon=1e-3, abs_epsilon=1e-4) == 0
+        assert ctx._resident is None and ctx._state is not None
+        # and a var write after materialization still round-trips
+        ctx.get_var("A").set_element(2.5, [4, 7, 7, 7])
+        assert ctx.get_var("A").get_element([4, 7, 7, 7]) == 2.5

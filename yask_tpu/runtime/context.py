@@ -66,6 +66,11 @@ class StencilContext:
         self._opts = KernelSettings(self._ana.domain_dims)
         self._program = None          # StepProgram (compute geometry)
         self._state: Optional[Dict[str, List]] = None
+        # Sharded interiors kept device-resident between shard-mode runs
+        # (pads stripped); _state is None while this is set and any host
+        # access materializes lazily (reference persistent var storage,
+        # yk_var.hpp:554).
+        self._resident: Optional[Dict[str, List]] = None
         self._state_on_device = False
         self._vars: Dict[str, yk_var] = {}
         self._cur_step = 0
@@ -178,6 +183,8 @@ class StencilContext:
         immutable under JAX, so sharing is simply adopting references."""
         self._check_prepared()
         other._check_prepared()
+        self._materialize_state()
+        other._materialize_state()
         for name, ring in other._state.items():
             if name not in self._state:
                 continue
@@ -273,6 +280,7 @@ class StencilContext:
                 extra[d] = (max(l, need), max(r, need))
         self._plan_kwargs = dict(extra_pad=extra, pad_multiple=pad_mult)
         self._program = self._csol.plan(gsizes, **self._plan_kwargs)
+        self._resident = None
         self._state = self._program.alloc_state()
         self._state_on_device = True
 
@@ -312,8 +320,19 @@ class StencilContext:
         if self._program is None:
             raise YaskException("prepare_solution has not been called")
 
+    def _materialize_state(self) -> None:
+        """Re-attach the (zero) global pads if state currently lives as
+        device-resident sharded interiors — the lazy sync point for any
+        host-visible var access between shard-mode runs."""
+        if self._resident is not None:
+            from yask_tpu.parallel.shard_step import _repad_global
+            res, self._resident = self._resident, None
+            self._state = _repad_global(self._program, list(res), res)
+            self._state_on_device = True
+
     def _update_state_array(self, name: str, slot: int, fn) -> None:
         self._check_prepared()
+        self._materialize_state()
         arr = self._state[name][slot]
         new = fn(np.asarray(arr))
         # Physical-boundary ghost cells are identically zero in every
@@ -345,12 +364,15 @@ class StencilContext:
         return out
 
     def _state_to_host(self) -> None:
+        self._materialize_state()
         if self._state_on_device:
             self._state = {k: [np.asarray(a) for a in ring]
                            for k, ring in self._state.items()}
             self._state_on_device = False
 
     def _state_to_device(self) -> None:
+        if self._resident is not None:
+            return  # interiors already device-resident (sharded)
         if not self._state_on_device:
             import jax
             out = {}
@@ -589,6 +611,8 @@ class StencilContext:
         reassociation noise at near-cancellation points doesn't count."""
         self._check_prepared()
         other._check_prepared()
+        self._materialize_state()
+        other._materialize_state()
 
         def interior(ctx, name, arr):
             g = ctx._program.geoms[name]
@@ -634,6 +658,7 @@ class StencilContext:
 
     def _trace_dump(self, t_written: int) -> None:
         import os
+        self._materialize_state()
         arrs = {}
         for name, ring in self._state.items():
             g = self._program.geoms[name]
@@ -666,6 +691,7 @@ class StencilContext:
     def save_checkpoint(self, path: str) -> None:
         """Snapshot all var state + step position to an .npz file."""
         self._check_prepared()
+        self._materialize_state()
         payload = {"__cur_step__": np.asarray(self._cur_step),
                    "__steps_done__": np.asarray(self._steps_done)}
         for name, ring in self._state.items():
@@ -676,6 +702,9 @@ class StencilContext:
     def load_checkpoint(self, path: str) -> None:
         """Restore a snapshot (shapes must match the prepared geometry)."""
         self._check_prepared()
+        # materialize (not discard) resident interiors: the restore
+        # validates shapes against the current rings
+        self._materialize_state()
         data = np.load(self._ckpt_path(path))
         new_state: Dict[str, List] = {}
         for name, ring in self._state.items():

@@ -15,6 +15,7 @@ import numpy as np
 
 
 def init_solution_vars(ctx, seed: float = 0.05) -> None:
+    ctx._materialize_state()   # sync any device-resident shard interiors
     written = {eq.lhs.var_name() for eq in ctx._soln.get_equations()}
     for i, name in enumerate(sorted(ctx.get_var_names())):
         if name in written:

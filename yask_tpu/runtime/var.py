@@ -106,14 +106,17 @@ class yk_var:
     # -- storage ----------------------------------------------------------
 
     def is_storage_allocated(self) -> bool:
-        return (self._ctx._state is not None
-                and self._name in self._ctx._state)
+        ctx = self._ctx
+        if ctx._resident is not None:
+            return self._name in ctx._resident
+        return ctx._state is not None and self._name in ctx._state
 
     def _ring(self) -> List:
         if not self.is_storage_allocated():
             raise YaskException(
                 f"storage for var '{self._name}' not allocated "
                 "(call prepare_solution)")
+        self._ctx._materialize_state()  # sync from resident shard state
         return self._ctx._state[self._name]
 
     def _slot_for_step(self, t: Optional[int]) -> int:
