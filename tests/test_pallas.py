@@ -186,3 +186,27 @@ def test_auto_tuner_joint_walk(env):
     ref.run_solution(0, 3)
     ctx.run_solution(0, 3)
     assert ctx.compare_data(ref) == 0
+
+
+def test_pallas_pipelined_dmas_match_unpipelined(env):
+    """Double-buffered input DMAs must be bit-identical to the
+    unpipelined kernel over a multi-block grid (VERDICT r1 item 3)."""
+    from yask_tpu.utils.idx_tuple import IdxTuple
+    from yask_tpu.ops.pallas_stencil import build_pallas_chunk
+    sb = create_solution("iso3dfd", radius=2)
+    prog = sb.get_soln().compile().plan(
+        IdxTuple(x=32, y=32, z=32),
+        extra_pad={"x": (4, 4), "y": (4, 4), "z": (0, 0)})
+    state = prog.alloc_state()
+    rng = np.random.RandomState(0)
+    state = {n: [np.asarray(a) + rng.rand(*np.asarray(a).shape)
+                 .astype(np.float32) * 0.01 for a in ring]
+             for n, ring in state.items()}
+    outs = {}
+    for pipe in (False, True):
+        chunk, _ = build_pallas_chunk(prog, fuse_steps=2, block=(8, 8),
+                                      interpret=True, pipeline_dmas=pipe)
+        outs[pipe] = chunk({k: list(v) for k, v in state.items()}, 0)
+    for n in outs[False]:
+        for a, b in zip(outs[False][n], outs[True][n]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -270,7 +270,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                        block: Optional[Tuple[int, ...]] = None,
                        interpret: bool = False,
                        vmem_budget: int = 100 * 2 ** 20,
-                       distributed: bool = False):
+                       distributed: bool = False,
+                       pipeline_dmas: Optional[bool] = None):
     """Build ``chunk(state, t0) -> state`` advancing ``fuse_steps`` steps
     in one fused Pallas sweep.
 
@@ -374,25 +375,46 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
 
     dtype = program.dtype
     esize = jnp.dtype(dtype).itemsize
-    tile_bytes = 0
+    in_tile_bytes = 0
     slots: Dict[str, int] = {}
     for n in var_order:
         g = program.geoms[n]
         nslots = len(program_state_slots(program, n))
         slots[n] = nslots
-        tile_bytes += nslots * int(
+        in_tile_bytes += nslots * int(
             math.prod(tile_shape(n))) * esize
     # workspace for sub-step results (rough: one extra tile per written
     # var) and the in-tile scratch values
-    tile_bytes += sum(int(math.prod(tile_shape(n))) * esize for n in written)
-    tile_bytes += sum(int(math.prod(tile_shape(n))) * esize
+    work_bytes = sum(int(math.prod(tile_shape(n))) * esize
+                     for n in written)
+    work_bytes += sum(int(math.prod(tile_shape(n))) * esize
                       for n in scratch_vars)
+    tile_bytes = in_tile_bytes + work_bytes
     if tile_bytes > vmem_budget:
         raise YaskException(
             f"pallas tile needs {tile_bytes/2**20:.1f} MiB VMEM "
             f"(budget {vmem_budget/2**20:.0f}); shrink block or fuse_steps")
 
     grid = tuple(sizes[d] // block[d] for d in lead)
+    total_steps = int(math.prod(grid)) if grid else 1
+
+    # Double-buffer the input-tile DMAs across grid steps: while step i
+    # computes on buffer i%2, step i+1's halo tiles stream into the other
+    # buffer (reference prefetch/early-load machinery, Cpp.hpp:263-287).
+    # Costs 2x input-tile VMEM; auto-disabled when that busts the budget
+    # or there's only one grid step. Grid dims are declared "arbitrary"
+    # (sequential) so the linear-index prefetch is sound.
+    if pipeline_dmas is None:
+        pipeline_dmas = (total_steps > 1
+                         and 2 * in_tile_bytes + work_bytes <= vmem_budget)
+    use_pipe = bool(pipeline_dmas) and total_steps > 1
+    if use_pipe:
+        tile_bytes = 2 * in_tile_bytes + work_bytes
+        if tile_bytes > vmem_budget:   # explicitly-requested pipelining
+            raise YaskException(
+                f"pallas pipelined tiles need {tile_bytes/2**20:.1f} MiB "
+                f"VMEM (budget {vmem_budget/2**20:.0f}); shrink block or "
+                "fuse_steps, or disable pipeline_dmas")
     minor_origin = {n: (g.pads[minor][0]
                         if minor in g.domain_dims else 0)
                     for n, g in program.geoms.items()}
@@ -420,30 +442,76 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
 
         pid = [pl.program_id(i) for i in range(len(lead))]
 
-        # 1) DMA halo tiles HBM → VMEM.
-        dmas = []
-        si = 0
-        for n in var_order:
-            g = program.geoms[n]
-            for s in range(slots[n]):
-                src = ins[si]
-                idxs = []
-                for dn, kind in g.axes:
-                    if kind == "misc" or dn == minor:
-                        idxs.append(slice(None))  # full extent
+        # 1) DMA halo tiles HBM → VMEM (double-buffered across grid
+        #    steps when use_pipe: compute on buffer li%2 while the next
+        #    step's tiles stream into the other buffer).
+        def in_dmas(coords, buf):
+            """The full set of input-tile copies for grid position
+            ``coords`` into buffer ``buf`` (reconstructed identically to
+            start and to wait)."""
+            out = []
+            si = 0
+            for n in var_order:
+                g = program.geoms[n]
+                for s in range(slots[n]):
+                    src = ins[si]
+                    idxs = []
+                    for dn, kind in g.axes:
+                        if kind == "misc" or dn == minor:
+                            idxs.append(slice(None))  # full extent
+                        else:
+                            di = lead.index(dn)
+                            start = (coords[di] * block[dn]
+                                     + g.origin[dn] - hK[dn])
+                            idxs.append(
+                                pl.ds(start, block[dn] + 2 * hK[dn]))
+                    if use_pipe:
+                        dst = scratch[si].at[buf]
+                        s_at = sem.at[buf, si]
                     else:
-                        di = lead.index(dn)
-                        start = (pid[di] * block[dn]
-                                 + g.origin[dn] - hK[dn])
-                        idxs.append(pl.ds(start, block[dn] + 2 * hK[dn]))
-                dma = pltpu.make_async_copy(
-                    src.at[tuple(idxs)] if idxs else src,
-                    scratch[si], sem.at[si])
+                        dst = scratch[si]
+                        s_at = sem.at[si]
+                    out.append(pltpu.make_async_copy(
+                        src.at[tuple(idxs)] if idxs else src, dst, s_at))
+                    si += 1
+            return out
+
+        if use_pipe:
+            li = pid[0]
+            for i in range(1, len(lead)):
+                li = li * grid[i] + pid[i]
+            cur = li % 2
+
+            @pl.when(li == 0)
+            def _warmup():
+                for dma in in_dmas(pid, 0):
+                    dma.start()
+
+            # decompose li+1 into grid coords for the prefetch
+            nxt = li + 1
+            nxt_coords = []
+            rem_ = nxt
+            for i in range(len(lead) - 1, -1, -1):
+                nxt_coords.append(rem_ % grid[i])
+                rem_ = rem_ // grid[i]
+            nxt_coords = nxt_coords[::-1]
+
+            @pl.when(nxt < total_steps)
+            def _prefetch():
+                for dma in in_dmas(nxt_coords, nxt % 2):
+                    dma.start()
+
+            for dma in in_dmas(pid, cur):
+                dma.wait()
+        else:
+            cur = None
+            for dma in in_dmas(pid, None):
                 dma.start()
-                dmas.append(dma)
-                si += 1
-        for dma in dmas:
-            dma.wait()
+            for dma in in_dmas(pid, None):
+                dma.wait()
+
+        def buf_ref(si):
+            return scratch[si].at[cur] if use_pipe else scratch[si]
 
         # tiles as values
         tiles: Dict[str, List] = {}
@@ -451,7 +519,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         for n in var_order:
             tiles[n] = []
             for s in range(slots[n]):
-                tiles[n].append(scratch[si][...])
+                tiles[n].append(buf_ref(si)[...])
                 si += 1
 
         # 2) K fused sub-steps; within each, every stage consumes its read
@@ -611,6 +679,10 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         # 3) write back the slots the K sub-steps actually produced (the
         #    newest min(K, alloc)); untouched older slots merely shifted
         #    and are rebuilt host-side from the existing padded inputs.
+        #    NOTE: outputs are deliberately NOT aliased onto evicted ring
+        #    slots — every tile DMA fetches halo margins from every slot,
+        #    so an in-place interior write by one grid step would corrupt
+        #    a later step's margin reads on real (aliasing) hardware.
         oi = 0
         for name in written:
             g = program.geoms[name]
@@ -651,17 +723,20 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 full.append(sizes[dn])
                 blk.append(block[dn])
                 kinds.append(lead.index(dn))
+
         def index_map(*pid, _kinds=tuple(kinds)):
             return tuple(0 if k is None else pid[k] for k in _kinds)
         return tuple(full), tuple(blk), index_map
 
     out_shapes = []
     out_specs = []
+    nout_total = 0
     for name in written:
         full, blk, imap = out_geometry(name)
         for _ in range(min(K, slots[name])):
             out_shapes.append(jax.ShapeDtypeStruct(full, dtype))
             out_specs.append(pl.BlockSpec(blk, imap))
+            nout_total += 1
 
     # leading scalars (step index, shard offsets) ride SMEM; arrays HBM
     in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] * nscalars \
@@ -669,8 +744,20 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     scratch_shapes = []
     for n in var_order:
         for _ in range(slots[n]):
-            scratch_shapes.append(pltpu.VMEM(tile_shape(n), dtype))
-    scratch_shapes.append(pltpu.SemaphoreType.DMA((n_inputs - nscalars,)))
+            shp = tile_shape(n)
+            if use_pipe:
+                shp = (2,) + shp
+            scratch_shapes.append(pltpu.VMEM(shp, dtype))
+    n_arrays = n_inputs - nscalars
+    scratch_shapes.append(pltpu.SemaphoreType.DMA(
+        (2, n_arrays) if use_pipe else (n_arrays,)))
+
+    kwargs = {}
+    if use_pipe and not interpret:
+        # sequential grid: the linear-index prefetch requires it (no
+        # megacore partitioning of grid dims)
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",) * len(grid))
 
     call = pl.pallas_call(
         kernel,
@@ -680,6 +767,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         out_shape=out_shapes,
         scratch_shapes=scratch_shapes,
         interpret=interpret,
+        **kwargs,
     )
 
     def chunk(state, t0, offsets=None):

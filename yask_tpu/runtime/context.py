@@ -180,7 +180,12 @@ class StencilContext:
         """Share storage with another prepared context where var geometry
         matches (``yk_solution::fuse_vars``, used by the reference's
         validation flow to alias vars between solutions). Arrays are
-        immutable under JAX, so sharing is simply adopting references."""
+        immutable under JAX, so sharing is simply adopting references.
+
+        Caveat: the jit path's compiled chunks donate their input
+        buffers, so after either context RUNS, buffers previously shared
+        through fuse_vars may be consumed — re-fuse after runs rather
+        than relying on stale aliases."""
         self._check_prepared()
         other._check_prepared()
         self._materialize_state()
@@ -324,6 +329,10 @@ class StencilContext:
         """Re-attach the (zero) global pads if state currently lives as
         device-resident sharded interiors — the lazy sync point for any
         host-visible var access between shard-mode runs."""
+        if self._resident is None and self._state is None:
+            raise YaskException(
+                "solution state was lost (a shard-mode run failed after "
+                "its buffers were donated); call prepare_solution again")
         if self._resident is not None:
             from yask_tpu.parallel.shard_step import _repad_global
             res, self._resident = self._resident, None
@@ -372,7 +381,9 @@ class StencilContext:
 
     def _state_to_device(self) -> None:
         if self._resident is not None:
-            return  # interiors already device-resident (sharded)
+            if self._mode in ("shard_map", "shard_pallas"):
+                return  # interiors already device-resident (sharded)
+            self._materialize_state()  # non-shard path needs padded state
         if not self._state_on_device:
             import jax
             out = {}
@@ -540,6 +551,8 @@ class StencilContext:
             else:
                 # AOT-compile so the first timed call doesn't include
                 # XLA/Mosaic compilation (mirrors _get_compiled_chunk).
+                # No donation: fuse_vars may share these ring buffers
+                # with a peer context.
                 fn = jax.jit(chunk).lower(self._state, 0).compile()
             self._jit_cache[key] = fn
             self._compile_secs += time.perf_counter() - t0c
