@@ -730,13 +730,11 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
 
     out_shapes = []
     out_specs = []
-    nout_total = 0
     for name in written:
         full, blk, imap = out_geometry(name)
         for _ in range(min(K, slots[name])):
             out_shapes.append(jax.ShapeDtypeStruct(full, dtype))
             out_specs.append(pl.BlockSpec(blk, imap))
-            nout_total += 1
 
     # leading scalars (step index, shard offsets) ride SMEM; arrays HBM
     in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] * nscalars \

@@ -86,17 +86,40 @@ def plan_blocks(program, fuse_steps: int = 1,
             per *= blk[d] + 2 * hK[d]
         return per * minor_ext * esize * max(nbuf, 1)
 
+    def overhead(blk):
+        """Read-reuse model: fraction of each tile's loads + compute that
+        is halo overlap recomputed by neighboring tiles — the quantity
+        the reference's fold planner minimizes as 'reads per point'
+        (``Vec.*``). Growing the dim with the worst surface/volume ratio
+        first buys the most reuse per VMEM byte."""
+        interior = 1
+        padded = 1
+        for d in lead:
+            interior *= blk[d]
+            padded *= blk[d] + 2 * hK[d]
+        return (padded - interior) / max(interior, 1)
+
     improved = True
-    while improved and tile_bytes(block) < vmem_budget // 2:
+    while improved:
         improved = False
-        for d in reversed(lead):  # grow the sublane dim first
-            cand = dict(block)
+        best = None
+        for d in lead:
             nb = block[d] * 2
             while nb <= sizes[d] and sizes[d] % nb != 0:
                 nb *= 2
-            if nb <= sizes[d]:
-                cand[d] = nb
-                if tile_bytes(cand) < vmem_budget // 2:
-                    block = cand
-                    improved = True
+            if nb > sizes[d]:
+                continue
+            cand = dict(block)
+            cand[d] = nb
+            if tile_bytes(cand) >= vmem_budget // 2:
+                continue
+            ov = overhead(cand)
+            if best is None or ov < best[0]:
+                best = (ov, cand)
+        # non-strict: growing a zero-halo dim leaves overhead unchanged
+        # but still shrinks the grid (fewer DMA launches) — keep growing
+        # to the VMEM target like the pre-reuse-model planner did
+        if best is not None and best[0] <= overhead(block):
+            block = best[1]
+            improved = True
     return block

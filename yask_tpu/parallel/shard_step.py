@@ -247,6 +247,37 @@ def _strip_global_interiors(ctx, gprog, names, mesh, specs_for, gsizes):
     return interior
 
 
+def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start):
+    """Measured halo fraction for one compiled variant: time the real
+    program against its no-exchange twin on copies of the interiors;
+    the shortfall is the per-call halo cost (reference halo-time
+    breakdown, ``context.hpp:318-328``). Cached under ``key``."""
+    import jax
+    import jax.numpy as jnp
+
+    def timed(f):
+        st = {k: [jnp.copy(a) for a in ring]
+              for k, ring in interior.items()}
+        t = jnp.asarray(start, dtype=jnp.int32)
+        st = f(st, t)           # warmup (compile + first dispatch)
+        jax.block_until_ready(st)
+        # repeat until the sample is long enough to be stable
+        calls = 0
+        t0 = time.perf_counter()
+        while calls < 8:
+            st = f(st, t)
+            jax.block_until_ready(st)
+            calls += 1
+            if time.perf_counter() - t0 >= 0.05 and calls >= 2:
+                break
+        return (time.perf_counter() - t0) / calls
+
+    t_no = timed(fn_no)
+    t_ex = timed(fn)
+    ctx._halo_frac[key] = max(0.0, 1.0 - t_no / t_ex) if t_ex > 0 else 0.0
+    return ctx._halo_frac[key]
+
+
 def _repad_global(gprog, names, out):
     """Re-attach the (zero) global pads on device."""
     import jax.numpy as jnp
@@ -432,34 +463,13 @@ def run_shard_map(ctx, start: int, n: int) -> None:
     cal_secs = 0.0
     if opts.measure_halo_time:
         t0cal = time.perf_counter()
-        cal = ctx._halo_frac
-        if key not in cal:
+        if key not in ctx._halo_frac:
             t0c = time.perf_counter()
             fn_no = build(_no_exchange)
             ctx._compile_secs += time.perf_counter() - t0c
-
-            def timed(f):
-                st = {k: [jnp.copy(a) for a in ring]
-                      for k, ring in interior.items()}
-                t = jnp.asarray(start, dtype=jnp.int32)
-                st = f(st, t)           # warmup (compile + first dispatch)
-                jax.block_until_ready(st)
-                # repeat until the sample is long enough to be stable
-                calls = 0
-                t0 = time.perf_counter()
-                while calls < 8:
-                    st = f(st, t)
-                    jax.block_until_ready(st)
-                    calls += 1
-                    if time.perf_counter() - t0 >= 0.05 and calls >= 2:
-                        break
-                return (time.perf_counter() - t0) / calls
-
-            t_no = timed(fn_no)
-            t_ex = timed(fn)
-            cal[key] = max(0.0, 1.0 - t_no / t_ex) if t_ex > 0 else 0.0
+            _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start)
             del fn_no
-        frac = cal[key]
+        frac = ctx._halo_frac[key]
         cal_secs = time.perf_counter() - t0cal
 
     t0c2 = time.perf_counter()
@@ -540,12 +550,14 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
     groups, rem = divmod(n, K)
     key = ("shard_pallas", n, K, blk)
 
-    if key not in ctx._jit_cache:
+    need_build = key not in ctx._jit_cache
+    need_cal = (opts.measure_halo_time and key not in ctx._halo_frac)
+    chunk = chunk_rem = None
+    if need_build or need_cal:
         interp = ctx._env.get_platform() != "tpu"
         chunk, tile_bytes = build_pallas_chunk(
             local_prog, fuse_steps=K, block=blk, interpret=interp,
             distributed=True)
-        chunk_rem = None
         if rem:
             chunk_rem, _ = build_pallas_chunk(
                 local_prog, fuse_steps=rem, block=blk, interpret=interp,
@@ -553,8 +565,12 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
         ctx._env.trace_msg(
             f"shard_pallas chunk: K={K}, blocks={blk or 'planner'}, "
             f"tile {tile_bytes / 2**20:.2f} MiB")
-        shard_map = _shard_map_fn()
 
+    def build(exchange):
+        """shard_map program with the given exchange implementation —
+        the no-exchange twin drives halo-time calibration exactly as in
+        run_shard_map."""
+        shard_map = _shard_map_fn()
         in_specs = ({k: [specs_for(k)] * slots[k] for k in names},
                     PartitionSpec())
         out_specs = {k: [specs_for(k)] * slots[k] for k in names}
@@ -572,7 +588,7 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
                 widths = _widths(g)
                 if widths:
                     state = {**state,
-                             k: [exchange_ghosts(a, g, widths, nr, lsizes)
+                             k: [exchange(a, g, widths, nr, lsizes)
                                  for a in state[k]]}
             return state
 
@@ -590,8 +606,8 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
                 ring = list(state[k])
                 nback = min(K, len(ring))
                 for i in range(len(ring) - nback, len(ring)):
-                    ring[i] = exchange_ghosts(ring[i], g, widths, nr,
-                                              lsizes)
+                    ring[i] = exchange(ring[i], g, widths, nr,
+                                       lsizes)
                 state = {**state, k: ring}
             return state
 
@@ -646,38 +662,56 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
             return out
 
         try:
-            mapped = shard_map(body, mesh=mesh, in_specs=in_specs,
-                               out_specs=out_specs, check_vma=False)
+            return shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
         except TypeError:  # older jax spells it check_rep
-            mapped = shard_map(body, mesh=mesh, in_specs=in_specs,
-                               out_specs=out_specs, check_rep=False)
-    else:
-        mapped = None
+            return shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False)
 
     # Strip global pads → sharded interiors, run, re-pad (device-side,
     # pads are zero by invariant). Same accounting as run_shard_map; the
     # stripped interiors serve both AOT lowering (first call) and the
-    # run, and compile time is excluded from the run window.
+    # run, and compile/calibration time is excluded from the run window.
     t0r = time.perf_counter()
     interior = _strip_global_interiors(ctx, gprog, names, mesh,
                                        specs_for, gsizes)
-    if mapped is not None:
+    if need_build:
         # AOT-compile so the first timed call doesn't include XLA/Mosaic
         # compilation (same policy as the single-device pallas path).
         t0c = time.perf_counter()
-        ctx._jit_cache[key] = jax.jit(mapped, donate_argnums=0) \
+        ctx._jit_cache[key] = \
+            jax.jit(build(exchange_ghosts), donate_argnums=0) \
             .lower(interior, jnp.asarray(start, dtype=jnp.int32)).compile()
         dtc = time.perf_counter() - t0c
         ctx._compile_secs += dtc
         t0r += dtc
     fn = ctx._jit_cache[key]
 
+    # Halo-time calibration against the no-exchange twin (same scheme
+    # and accounting as run_shard_map).
+    frac = 0.0
+    if opts.measure_halo_time:
+        if need_cal:
+            t0cal = time.perf_counter()
+            t0c = time.perf_counter()
+            fn_no = jax.jit(build(_no_exchange), donate_argnums=0) \
+                .lower(interior,
+                       jnp.asarray(start, dtype=jnp.int32)).compile()
+            ctx._compile_secs += time.perf_counter() - t0c
+            _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start)
+            del fn_no
+            t0r += time.perf_counter() - t0cal
+        frac = ctx._halo_frac[key]
+
     ctx._resident = None   # interior buffers are donated next; any
     #                          failure before this point kept them valid
+    t0c2 = time.perf_counter()
     out = fn(interior, jnp.asarray(start, dtype=jnp.int32))
     jax.block_until_ready(out)
+    dt_call = time.perf_counter() - t0c2
     # Keep the interiors device-resident: the next shard-mode run takes
     # them directly, and any host access materializes (re-pads) lazily.
     ctx._resident = out
     ctx._state = None
     ctx._run_timer._elapsed += time.perf_counter() - t0r
+    ctx._halo_timer._elapsed += frac * dt_call
