@@ -136,3 +136,46 @@ def test_checkpoint_shape_mismatch(env, tmp_path):
     b.prepare_solution()
     with pytest.raises(YaskException):
         b.load_checkpoint(ck)
+
+
+# ---------------------------------------------------------------------------
+# C/C++ kernel API (embedded-interpreter front end, reference yk_* C++ API)
+# ---------------------------------------------------------------------------
+
+
+def test_cpp_api_demo(tmp_path):
+    """Build the C API library + demo app and run it end to end: the
+    C++ front end must drive the same runtime (build, configure, seed,
+    run, oracle-compare) — the analog of the reference's C++ kernel API
+    test (``yask_kernel_api_test.cpp``)."""
+    import shutil
+    import subprocess
+    import sys
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("no C++ toolchain")
+    # embed THIS interpreter (the one with jax installed), not whatever
+    # python3-config happens to be on PATH
+    cfg = sys.executable + "-config"
+    if not os.path.exists(cfg):
+        cfg = os.path.join(os.path.dirname(sys.executable),
+                           "python3-config")
+    if not os.path.exists(cfg):
+        cfg = shutil.which("python3-config")
+    if cfg is None:
+        pytest.skip("no python3-config for embedding")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ndir = os.path.join(repo, "yask_tpu", "native")
+    r = subprocess.run(["make", "-C", ndir, "capi", f"PYCFG={cfg}"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    env_ = dict(os.environ)
+    env_["PALLAS_AXON_POOL_IPS"] = ""
+    env_["JAX_PLATFORMS"] = "cpu"
+    env_["PYTHONPATH"] = os.pathsep.join(
+        [repo] + [p for p in env_.get("PYTHONPATH", "").split(os.pathsep)
+                  if p])
+    r = subprocess.run([os.path.join(ndir, "capi_demo")],
+                       capture_output=True, text=True, timeout=300,
+                       env=env_)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "capi demo passed" in r.stdout

@@ -1,0 +1,247 @@
+/* C API implementation: embeds CPython and drives the yask_tpu runtime.
+ *
+ * The reference links apps against libyask_kernel and generated stencil
+ * code (src/kernel/Makefile); the TPU framework's runtime is Python/JAX,
+ * so the C ABI hosts the interpreter instead — the same re-design choice
+ * as the SWIG direction reversed. One interpreter per process; handles
+ * are owned references to StencilContext objects.
+ */
+#include "yask_tpu_api.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+namespace {
+
+std::string g_err;
+PyObject *g_factory = nullptr;   // yk_factory instance
+PyObject *g_env = nullptr;       // yk_env instance
+
+void capture_py_error(const char *what) {
+    g_err = what;
+    if (PyErr_Occurred()) {
+        PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+        PyErr_Fetch(&type, &value, &tb);
+        PyErr_NormalizeException(&type, &value, &tb);
+        if (value) {
+            PyObject *s = PyObject_Str(value);
+            if (s) {
+                g_err += ": ";
+                g_err += PyUnicode_AsUTF8(s);
+                Py_DECREF(s);
+            }
+        }
+        Py_XDECREF(type);
+        Py_XDECREF(value);
+        Py_XDECREF(tb);
+    }
+}
+
+PyObject *call_method(PyObject *obj, const char *name, PyObject *args) {
+    PyObject *m = PyObject_GetAttrString(obj, name);
+    if (!m) return nullptr;
+    PyObject *r = PyObject_CallObject(m, args);
+    Py_DECREF(m);
+    return r;
+}
+
+PyObject *idx_list(const long *idxs, int n) {
+    PyObject *lst = PyList_New(n);
+    for (int i = 0; i < n; i++)
+        PyList_SET_ITEM(lst, i, PyLong_FromLong(idxs[i]));
+    return lst;
+}
+
+PyObject *get_var(PyObject *ctx, const char *var) {
+    PyObject *args = Py_BuildValue("(s)", var);
+    PyObject *v = call_method(ctx, "get_var", args);
+    Py_DECREF(args);
+    return v;
+}
+
+} // namespace
+
+extern "C" {
+
+int yt_initialize(void) {
+    if (g_factory) return 0;
+    if (!Py_IsInitialized()) Py_Initialize();
+    PyObject *mod = PyImport_ImportModule("yask_tpu");
+    if (!mod) {
+        capture_py_error("import yask_tpu failed");
+        return 1;
+    }
+    PyObject *fac_cls = PyObject_GetAttrString(mod, "yk_factory");
+    Py_DECREF(mod);
+    if (!fac_cls) {
+        capture_py_error("yk_factory missing");
+        return 1;
+    }
+    g_factory = PyObject_CallObject(fac_cls, nullptr);
+    Py_DECREF(fac_cls);
+    if (!g_factory) {
+        capture_py_error("yk_factory() failed");
+        return 1;
+    }
+    g_env = call_method(g_factory, "new_env", nullptr);
+    if (!g_env) {
+        capture_py_error("new_env() failed");
+        Py_CLEAR(g_factory);
+        return 1;
+    }
+    return 0;
+}
+
+void yt_finalize(void) {
+    Py_CLEAR(g_env);
+    Py_CLEAR(g_factory);
+    /* interpreter stays up: cheap, and JAX dislikes re-init */
+}
+
+void *yt_new_solution(const char *stencil, int radius) {
+    if (yt_initialize() != 0) return nullptr;
+    PyObject *kwargs = PyDict_New();
+    PyObject *sv = PyUnicode_FromString(stencil);
+    PyDict_SetItemString(kwargs, "stencil", sv);   // does NOT steal
+    Py_DECREF(sv);
+    if (radius > 0) {
+        PyObject *rv = PyLong_FromLong(radius);
+        PyDict_SetItemString(kwargs, "radius", rv);
+        Py_DECREF(rv);
+    }
+    PyObject *args = Py_BuildValue("(O)", g_env);
+    PyObject *m = PyObject_GetAttrString(g_factory, "new_solution");
+    PyObject *ctx = m ? PyObject_Call(m, args, kwargs) : nullptr;
+    Py_XDECREF(m);
+    Py_DECREF(args);
+    Py_DECREF(kwargs);
+    if (!ctx) {
+        capture_py_error("new_solution failed");
+        return nullptr;
+    }
+    return ctx;
+}
+
+void yt_free_solution(void *soln) {
+    Py_XDECREF((PyObject *)soln);
+}
+
+int yt_apply_options(void *soln, const char *cli) {
+    PyObject *args = Py_BuildValue("(s)", cli);
+    PyObject *r = call_method((PyObject *)soln,
+                              "apply_command_line_options", args);
+    Py_DECREF(args);
+    if (!r) {
+        capture_py_error("apply_command_line_options failed");
+        return 1;
+    }
+    Py_DECREF(r);
+    return 0;
+}
+
+int yt_prepare(void *soln) {
+    PyObject *r = call_method((PyObject *)soln, "prepare_solution",
+                              nullptr);
+    if (!r) {
+        capture_py_error("prepare_solution failed");
+        return 1;
+    }
+    Py_DECREF(r);
+    return 0;
+}
+
+static int run_steps(void *soln, const char *method, long a, long b) {
+    PyObject *args = Py_BuildValue("(ll)", a, b);
+    PyObject *r = call_method((PyObject *)soln, method, args);
+    Py_DECREF(args);
+    if (!r) {
+        capture_py_error(method);
+        return 1;
+    }
+    Py_DECREF(r);
+    return 0;
+}
+
+int yt_run(void *soln, long first_step, long last_step) {
+    return run_steps(soln, "run_solution", first_step, last_step);
+}
+
+int yt_run_ref(void *soln, long first_step, long last_step) {
+    return run_steps(soln, "run_ref", first_step, last_step);
+}
+
+int yt_set_element(void *soln, const char *var, double val,
+                   const long *idxs, int nidx) {
+    PyObject *v = get_var((PyObject *)soln, var);
+    if (!v) {
+        capture_py_error("get_var failed");
+        return 1;
+    }
+    PyObject *args = Py_BuildValue("(dN)", val, idx_list(idxs, nidx));
+    PyObject *r = call_method(v, "set_element", args);
+    Py_DECREF(args);
+    Py_DECREF(v);
+    if (!r) {
+        capture_py_error("set_element failed");
+        return 1;
+    }
+    Py_DECREF(r);
+    return 0;
+}
+
+double yt_get_element(void *soln, const char *var,
+                      const long *idxs, int nidx) {
+    g_err.clear();   // NaN doubles as the error sentinel: a cleared
+    //                  error message marks a legitimately-NaN element
+    PyObject *v = get_var((PyObject *)soln, var);
+    if (!v) {
+        capture_py_error("get_var failed");
+        return nan("");
+    }
+    PyObject *args = Py_BuildValue("(N)", idx_list(idxs, nidx));
+    PyObject *r = call_method(v, "get_element", args);
+    Py_DECREF(args);
+    Py_DECREF(v);
+    if (!r) {
+        capture_py_error("get_element failed");
+        return nan("");
+    }
+    double out = PyFloat_AsDouble(r);
+    Py_DECREF(r);
+    if (PyErr_Occurred()) {
+        capture_py_error("get_element: not a number");
+        return nan("");
+    }
+    return out;
+}
+
+long yt_compare(void *soln, void *other, double epsilon,
+                double abs_epsilon) {
+    PyObject *kwargs = PyDict_New();
+    PyObject *ev = PyFloat_FromDouble(epsilon);
+    PyObject *av = PyFloat_FromDouble(abs_epsilon);
+    PyDict_SetItemString(kwargs, "epsilon", ev);       // does NOT steal
+    PyDict_SetItemString(kwargs, "abs_epsilon", av);
+    Py_DECREF(ev);
+    Py_DECREF(av);
+    PyObject *args = Py_BuildValue("(O)", (PyObject *)other);
+    PyObject *m = PyObject_GetAttrString((PyObject *)soln, "compare_data");
+    PyObject *r = m ? PyObject_Call(m, args, kwargs) : nullptr;
+    Py_XDECREF(m);
+    Py_DECREF(args);
+    Py_DECREF(kwargs);
+    if (!r) {
+        capture_py_error("compare_data failed");
+        return -1;
+    }
+    long out = PyLong_AsLong(r);
+    Py_DECREF(r);
+    return out;
+}
+
+const char *yt_last_error(void) { return g_err.c_str(); }
+
+} /* extern "C" */

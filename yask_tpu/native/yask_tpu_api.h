@@ -1,0 +1,114 @@
+/* C/C++ kernel API for the yask_tpu framework.
+ *
+ * Counterpart of the reference's C++ kernel API surface
+ * (include/yask_kernel_api.hpp yk_* classes, exported to apps via SWIG):
+ * here the runtime is Python/JAX, so the C ABI embeds the CPython
+ * interpreter and drives the same yk_factory/StencilContext objects a
+ * Python caller would — one runtime, two front ends.
+ *
+ * Usage (C):
+ *   yt_initialize();
+ *   void *s = yt_new_solution("iso3dfd", 8);
+ *   yt_apply_options(s, "-g 128");
+ *   yt_prepare(s);
+ *   long idx[] = {0, 64, 64, 64};
+ *   yt_set_element(s, "pressure", 1.0, idx, 4);
+ *   yt_run(s, 0, 9);
+ *   ...
+ *   yt_free_solution(s);
+ *   yt_finalize();
+ *
+ * A RAII C++ wrapper (yask_tpu::Solution) follows the C declarations.
+ * All functions return 0 / a valid value on success; on failure they
+ * return nonzero / NaN and yt_last_error() describes the problem.
+ */
+#ifndef YASK_TPU_API_H
+#define YASK_TPU_API_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+int yt_initialize(void);
+void yt_finalize(void);
+
+void *yt_new_solution(const char *stencil, int radius /* <=0: default */);
+void yt_free_solution(void *soln);
+
+int yt_apply_options(void *soln, const char *cli);
+int yt_prepare(void *soln);
+int yt_run(void *soln, long first_step, long last_step);
+int yt_run_ref(void *soln, long first_step, long last_step);
+
+int yt_set_element(void *soln, const char *var, double val,
+                   const long *idxs, int nidx);
+double yt_get_element(void *soln, const char *var,
+                      const long *idxs, int nidx);
+
+/* #mismatching points between two prepared solutions (-1 on error). */
+long yt_compare(void *soln, void *other, double epsilon,
+                double abs_epsilon);
+
+const char *yt_last_error(void);
+
+#ifdef __cplusplus
+} /* extern "C" */
+
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace yask_tpu {
+
+class Solution {
+  public:
+    Solution(const std::string &stencil, int radius = 0)
+        : h_(yt_new_solution(stencil.c_str(), radius)) {
+        if (!h_) throw std::runtime_error(yt_last_error());
+    }
+    ~Solution() { if (h_) yt_free_solution(h_); }
+    Solution(const Solution &) = delete;
+    Solution &operator=(const Solution &) = delete;
+
+    void apply_options(const std::string &cli) {
+        check(yt_apply_options(h_, cli.c_str()));
+    }
+    void prepare() { check(yt_prepare(h_)); }
+    void run(long first, long last) { check(yt_run(h_, first, last)); }
+    void run_ref(long first, long last) {
+        check(yt_run_ref(h_, first, last));
+    }
+    void set_element(const std::string &var, double val,
+                     const std::vector<long> &idxs) {
+        check(yt_set_element(h_, var.c_str(), val, idxs.data(),
+                             (int)idxs.size()));
+    }
+    double get_element(const std::string &var,
+                       const std::vector<long> &idxs) {
+        double v = yt_get_element(h_, var.c_str(), idxs.data(),
+                                  (int)idxs.size());
+        // NaN is the error sentinel, but a stored NaN is legal data:
+        // the C layer clears its error first, so only a non-empty
+        // message marks a real failure.
+        if (v != v && yt_last_error()[0] != '\0')
+            throw std::runtime_error(yt_last_error());
+        return v;
+    }
+    long compare(Solution &other, double eps = 1e-4,
+                 double abs_eps = 1e-7) {
+        long n = yt_compare(h_, other.h_, eps, abs_eps);
+        if (n < 0) throw std::runtime_error(yt_last_error());
+        return n;
+    }
+
+  private:
+    static void check(int rc) {
+        if (rc != 0) throw std::runtime_error(yt_last_error());
+    }
+    void *h_;
+};
+
+} // namespace yask_tpu
+#endif /* __cplusplus */
+
+#endif /* YASK_TPU_API_H */

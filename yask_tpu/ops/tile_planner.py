@@ -116,10 +116,10 @@ def plan_blocks(program, fuse_steps: int = 1,
             ov = overhead(cand)
             if best is None or ov < best[0]:
                 best = (ov, cand)
-        # non-strict: growing a zero-halo dim leaves overhead unchanged
-        # but still shrinks the grid (fewer DMA launches) — keep growing
-        # to the VMEM target like the pre-reuse-model planner did
-        if best is not None and best[0] <= overhead(block):
+        # doubling can only reduce (or, for zero-halo dims, preserve)
+        # the overhead, and either way shrinks the grid — take the best
+        # fitting candidate until nothing fits the VMEM target
+        if best is not None:
             block = best[1]
             improved = True
     return block

@@ -445,16 +445,26 @@ class StencilContext:
             self._run_ref_steps(start, n)
         elif self._mode == "pallas":
             self._run_pallas_steps(start, n)
-        elif self._mode == "shard_map":
-            from yask_tpu.parallel.shard_step import run_shard_map
+        elif self._mode in ("shard_map", "shard_pallas"):
+            from yask_tpu.parallel.shard_step import (run_shard_map,
+                                                      run_shard_pallas)
+            runner = run_shard_map if self._mode == "shard_map" \
+                else run_shard_pallas
             self._state_to_device()
-            # run_shard_map does its own timer accounting: halo
-            # calibration and twin compiles must stay out of elapsed.
-            run_shard_map(self, start, n)
-        elif self._mode == "shard_pallas":
-            from yask_tpu.parallel.shard_step import run_shard_pallas
-            self._state_to_device()
-            run_shard_pallas(self, start, n)
+            # wf_steps chunks the span so ONE compiled program length
+            # serves any run length (programs are cached per length);
+            # interiors stay device-resident across chunks. The runner
+            # does its own timer accounting: halo calibration and twin
+            # compiles must stay out of elapsed.
+            wf = self._opts.wf_steps if self._opts.wf_steps > 0 else n
+            if self._mode == "shard_pallas":
+                wf = n   # its fusion/grouping happens inside the program
+            t, rem = start, n
+            while rem > 0:
+                k = min(wf, rem)
+                runner(self, t, k)
+                t += k * self._ana.step_dir
+                rem -= k
         else:
             self._run_jit_steps(start, n)
 
