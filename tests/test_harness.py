@@ -80,3 +80,16 @@ def test_examples_run():
                            timeout=300)
         assert p.returncode == 0, p.stderr[-800:]
         assert "PASS" in p.stdout
+
+
+def test_profile_flag(tmp_path):
+    """-profile wraps the timed trials in a jax.profiler trace
+    (SURVEY §5 tracing row: XLA-op-level profiling integration)."""
+    import os
+    d = str(tmp_path / "prof")
+    rc, text = run_cli(["-stencil", "3axis", "-g", "16",
+                        "-trial_steps", "2", "-num_trials", "1",
+                        "-profile", d])
+    assert rc == 0, text
+    assert "profiling trials into" in text
+    assert os.path.isdir(os.path.join(d, "plugins", "profile"))

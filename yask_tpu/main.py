@@ -38,6 +38,7 @@ class HarnessSettings:
         self.init_seed = 0.1
         self.pre_auto_tune = False
         self.trace = False
+        self.profile_dir = ""     # jax.profiler trace output
         self.list_stencils = False
         self.help = False
 
@@ -58,6 +59,11 @@ class HarnessSettings:
                          "like the reference's '-trial_steps 2' validation "
                          "runs: fp32 noise compounds per step).",
                          self, "validate_steps")
+        p.add_string_option(
+            "profile", "Write a jax.profiler trace of the timed trials "
+            "to this directory (open with TensorBoard/xprof — the "
+            "view_asm/trace analog at the XLA-op level).",
+            self, "profile_dir")
         p.add_float_option("init_seed", "Per-var init sequence seed.",
                            self, "init_seed")
         p.add_bool_option("auto_tune", "Pre-run the auto-tuner.",
@@ -146,26 +152,38 @@ def run_harness(argv: Optional[List[str]] = None, out=None) -> int:
     out.write(f"warmup done ({warm} step(s); compile "
               f"{ctx.get_stats().get_compile_secs():.3g} s).\n")
 
+    profiling = False
+    if opts.profile_dir:
+        env.start_profiler_trace(opts.profile_dir)
+        profiling = True
+        out.write(f"profiling trials into {opts.profile_dir}\n")
+
     rates = []
-    for trial in range(opts.num_trials):
-        ctx.clear_stats()
-        t0 = time.perf_counter()
-        ctx.run_solution(t, t + opts.trial_steps - 1)
-        dt = time.perf_counter() - t0
-        t += opts.trial_steps
-        pts_ps = npts * opts.trial_steps / dt
-        rates.append(pts_ps)
-        st = ctx.get_stats()
-        out.write(f"trial {trial + 1}/{opts.num_trials}:\n")
-        out.write(f"  num-steps-done: {opts.trial_steps}\n")
-        out.write(f"  elapsed-time (sec): {dt:.6g}\n")
-        out.write(f"  throughput (num-points/sec): {pts_ps:.6g}\n")
-        out.write(f"  throughput (est-FLOPS): "
-                  f"{pts_ps * soln_ana.counters.num_ops:.6g}\n")
-        if st.get_halo_secs() > 0:
-            out.write(f"  halo-time (sec): {st.get_halo_secs():.6g}\n")
-            out.write(f"  halo-fraction (%): "
-                      f"{100.0 * st.get_halo_secs() / max(dt, 1e-12):.4g}\n")
+    try:
+        for trial in range(opts.num_trials):
+            ctx.clear_stats()
+            t0 = time.perf_counter()
+            ctx.run_solution(t, t + opts.trial_steps - 1)
+            dt = time.perf_counter() - t0
+            t += opts.trial_steps
+            pts_ps = npts * opts.trial_steps / dt
+            rates.append(pts_ps)
+            st = ctx.get_stats()
+            out.write(f"trial {trial + 1}/{opts.num_trials}:\n")
+            out.write(f"  num-steps-done: {opts.trial_steps}\n")
+            out.write(f"  elapsed-time (sec): {dt:.6g}\n")
+            out.write(f"  throughput (num-points/sec): {pts_ps:.6g}\n")
+            out.write(f"  throughput (est-FLOPS): "
+                      f"{pts_ps * soln_ana.counters.num_ops:.6g}\n")
+            if st.get_halo_secs() > 0:
+                out.write(f"  halo-time (sec): "
+                          f"{st.get_halo_secs():.6g}\n")
+                out.write(
+                    f"  halo-fraction (%): "
+                    f"{100.0 * st.get_halo_secs() / max(dt, 1e-12):.4g}\n")
+    finally:
+        if profiling:
+            env.stop_profiler_trace()
 
     rates.sort()
     mid = rates[len(rates) // 2]

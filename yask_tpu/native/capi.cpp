@@ -10,6 +10,7 @@
 
 #include <Python.h>
 
+#include <cmath>
 #include <cstdio>
 #include <cstring>
 #include <string>
@@ -19,6 +20,13 @@ namespace {
 std::string g_err;
 PyObject *g_factory = nullptr;   // yk_factory instance
 PyObject *g_env = nullptr;       // yk_env instance
+
+/* Every yt_* body holds the GIL (callable from any host thread). */
+struct Gil {
+    PyGILState_STATE st;
+    Gil() : st(PyGILState_Ensure()) {}
+    ~Gil() { PyGILState_Release(st); }
+};
 
 void capture_py_error(const char *what) {
     g_err = what;
@@ -66,9 +74,7 @@ PyObject *get_var(PyObject *ctx, const char *var) {
 
 extern "C" {
 
-int yt_initialize(void) {
-    if (g_factory) return 0;
-    if (!Py_IsInitialized()) Py_Initialize();
+static int setup_locked(void) {
     PyObject *mod = PyImport_ImportModule("yask_tpu");
     if (!mod) {
         capture_py_error("import yask_tpu failed");
@@ -95,7 +101,28 @@ int yt_initialize(void) {
     return 0;
 }
 
+int yt_initialize(void) {
+    if (g_factory) return 0;
+    bool we_initialized = false;
+    if (!Py_IsInitialized()) {
+        Py_Initialize();   // this thread now holds the GIL
+        we_initialized = true;
+    }
+    int rc;
+    {
+        Gil gil;
+        rc = setup_locked();
+    }
+    if (we_initialized)
+        /* release the GIL we acquired via Py_Initialize so any host
+         * thread can enter through PyGILState_Ensure afterwards */
+        (void)PyEval_SaveThread();
+    return rc;
+}
+
 void yt_finalize(void) {
+    if (!g_factory) return;
+    Gil gil;
     Py_CLEAR(g_env);
     Py_CLEAR(g_factory);
     /* interpreter stays up: cheap, and JAX dislikes re-init */
@@ -103,6 +130,7 @@ void yt_finalize(void) {
 
 void *yt_new_solution(const char *stencil, int radius) {
     if (yt_initialize() != 0) return nullptr;
+    Gil gil;
     PyObject *kwargs = PyDict_New();
     PyObject *sv = PyUnicode_FromString(stencil);
     PyDict_SetItemString(kwargs, "stencil", sv);   // does NOT steal
@@ -126,10 +154,12 @@ void *yt_new_solution(const char *stencil, int radius) {
 }
 
 void yt_free_solution(void *soln) {
+    Gil gil;
     Py_XDECREF((PyObject *)soln);
 }
 
 int yt_apply_options(void *soln, const char *cli) {
+    Gil gil;
     PyObject *args = Py_BuildValue("(s)", cli);
     PyObject *r = call_method((PyObject *)soln,
                               "apply_command_line_options", args);
@@ -143,6 +173,7 @@ int yt_apply_options(void *soln, const char *cli) {
 }
 
 int yt_prepare(void *soln) {
+    Gil gil;
     PyObject *r = call_method((PyObject *)soln, "prepare_solution",
                               nullptr);
     if (!r) {
@@ -154,6 +185,7 @@ int yt_prepare(void *soln) {
 }
 
 static int run_steps(void *soln, const char *method, long a, long b) {
+    Gil gil;
     PyObject *args = Py_BuildValue("(ll)", a, b);
     PyObject *r = call_method((PyObject *)soln, method, args);
     Py_DECREF(args);
@@ -175,6 +207,7 @@ int yt_run_ref(void *soln, long first_step, long last_step) {
 
 int yt_set_element(void *soln, const char *var, double val,
                    const long *idxs, int nidx) {
+    Gil gil;
     PyObject *v = get_var((PyObject *)soln, var);
     if (!v) {
         capture_py_error("get_var failed");
@@ -194,12 +227,13 @@ int yt_set_element(void *soln, const char *var, double val,
 
 double yt_get_element(void *soln, const char *var,
                       const long *idxs, int nidx) {
+    Gil gil;
     g_err.clear();   // NaN doubles as the error sentinel: a cleared
     //                  error message marks a legitimately-NaN element
     PyObject *v = get_var((PyObject *)soln, var);
     if (!v) {
         capture_py_error("get_var failed");
-        return nan("");
+        return std::nan("");
     }
     PyObject *args = Py_BuildValue("(N)", idx_list(idxs, nidx));
     PyObject *r = call_method(v, "get_element", args);
@@ -207,19 +241,20 @@ double yt_get_element(void *soln, const char *var,
     Py_DECREF(v);
     if (!r) {
         capture_py_error("get_element failed");
-        return nan("");
+        return std::nan("");
     }
     double out = PyFloat_AsDouble(r);
     Py_DECREF(r);
     if (PyErr_Occurred()) {
         capture_py_error("get_element: not a number");
-        return nan("");
+        return std::nan("");
     }
     return out;
 }
 
 long yt_compare(void *soln, void *other, double epsilon,
                 double abs_epsilon) {
+    Gil gil;
     PyObject *kwargs = PyDict_New();
     PyObject *ev = PyFloat_FromDouble(epsilon);
     PyObject *av = PyFloat_FromDouble(abs_epsilon);
