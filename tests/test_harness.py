@@ -93,3 +93,20 @@ def test_profile_flag(tmp_path):
     assert rc == 0, text
     assert "profiling trials into" in text
     assert os.path.isdir(os.path.join(d, "plugins", "profile"))
+
+
+def test_distributed_example_runs():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(root, "examples", "distributed_iso3dfd_main.py"),
+         "-g", "32", "-steps", "8"],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert p.returncode == 0, p.stderr[-800:]
+    assert "self-check passed" in p.stdout

@@ -105,6 +105,11 @@ def test_pallas_multi_stage_ssg(env):
     ("test_misc_2d", None),  # interleaved misc dims, misc-only vars
     ("test_stream_3d", None),  # zero spatial halo + deep time ring
     ("test_boundary_3d", None),  # box-interior IF_DOMAIN pair
+    ("test_4d", None),       # 4-D: three lead dims on the grid
+    ("test_reverse_2d", None),  # reverse-time stepping in-tile
+    ("fsg", 2),              # large multi-var staggered family
+    ("awp_abc", None),       # sponge ABC + conditions
+    ("wave2d", None),        # 2nd-order-in-time (3-slot ring) physics
 ])
 def test_pallas_condition_and_partial_class(env, name, radius):
     from yask_tpu.runtime.init_utils import init_solution_vars
