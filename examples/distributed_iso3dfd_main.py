@@ -57,14 +57,10 @@ def main(argv=None) -> int:
     fac = yk_factory()
     env = fac.new_env()
     ndev = env.get_num_ranks()
-    # 2-D mesh when composite, else 1-D over x
-    nx, ny = ndev, 1
-    f = int(ndev ** 0.5)
-    while f > 1:
-        if ndev % f == 0:
-            nx, ny = ndev // f, f
-            break
-        f -= 1
+    # the library's TPU-first compact factorization (minor dim whole)
+    from yask_tpu.parallel.decomp import factorize_rank_grid
+    grid = factorize_rank_grid(ndev, ["x", "y", "z"])
+    nx, ny = grid["x"], grid["y"]
     print(f"iso3dfd on {env.get_platform()} x {ndev} device(s): "
           f"mesh {nx}x{ny}, g={g}^3, radius {radius}, K={wf}")
 

@@ -98,6 +98,7 @@ def test_profile_flag(tmp_path):
 def test_distributed_example_runs():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""   # never dial the relay (CLAUDE.md)
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         env["XLA_FLAGS"] = (
