@@ -23,7 +23,7 @@ import sys
 import time
 
 
-def _probe_platform():
+def _probe_platform(default_timeout: float = 240.0):
     """Decide the jax platform WITHOUT risking a hang in this process.
 
     The default backend dials a TPU relay that, when unreachable, hangs
@@ -37,9 +37,10 @@ def _probe_platform():
     if cached is not None:                          # process tree
         return cached or None  # "" caches a failed probe
     try:
-        timeout = float(os.environ.get("YT_TPU_PROBE_TIMEOUT", "240"))
+        timeout = float(os.environ.get("YT_TPU_PROBE_TIMEOUT",
+                                       str(default_timeout)))
     except ValueError:
-        timeout = 240.0
+        timeout = default_timeout
     code = "import jax; print('PLATFORM=' + jax.default_backend())"
     # Popen + process group + hard kill: subprocess.run(timeout=) can
     # block forever in communicate() when the backend plugin spawns a
