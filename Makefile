@@ -5,18 +5,36 @@
 PY ?= python
 TEST_ENV ?= PALLAS_AXON_POOL_IPS=
 
-.PHONY: all native test test-fast bench examples clean list-stencils
+.PHONY: all native capi test test-fast scratch-tests boundary-tests \
+        stages-tests mode-tests bench examples clean list-stencils
 
 all: native test
 
 native:
 	$(MAKE) -C yask_tpu/native
 
+capi:
+	$(MAKE) -C yask_tpu/native capi
+
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
 
 test-fast:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -x -k "not stencil_validates"
+
+# focused suites (reference scratch-tests/boundary-tests/stages-tests,
+# src/kernel/Makefile:1186-1192)
+scratch-tests:
+	$(TEST_ENV) $(PY) -m pytest tests/ -q -k "scratch"
+
+boundary-tests:
+	$(TEST_ENV) $(PY) -m pytest tests/ -q -k "boundary"
+
+stages-tests:
+	$(TEST_ENV) $(PY) -m pytest tests/ -q -k "stages or stage"
+
+mode-tests:
+	$(TEST_ENV) $(PY) -m pytest tests/test_modes.py tests/test_pallas.py -q
 
 bench:
 	$(PY) bench.py
