@@ -334,3 +334,29 @@ def test_overlap_split_asymmetric_ghosts(env, ranks, overlap):
     ref = _asym(env, "ref")
     sm = _asym(env, "shard_map", ranks=ranks, overlap=overlap)
     assert sm.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+def test_reverse_time_distributed(env):
+    """Reverse-time stepping through both distributed paths, incl. the
+    fused shard_pallas ring rotation in the negative step direction."""
+    from yask_tpu.runtime.init_utils import init_solution_vars
+
+    def run(mode, wf=0, ranks=()):
+        ctx = yk_factory().new_solution(env, stencil="test_reverse_2d")
+        ctx.apply_command_line_options("-g 24")
+        ctx.get_settings().mode = mode
+        ctx.get_settings().wf_steps = wf
+        for d, r in ranks:
+            ctx.set_num_ranks(d, r)
+        ctx.prepare_solution()
+        init_solution_vars(ctx)
+        ctx.run_solution(0, 2)
+        return ctx
+
+    ref = run("ref")
+    for mode, wf, ranks in (("shard_map", 0, (("x", 4),)),
+                            ("shard_pallas", 1, (("x", 4),)),
+                            ("shard_pallas", 2, (("x", 2),))):
+        c = run(mode, wf=wf, ranks=ranks)
+        assert c.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0, \
+            (mode, wf)
