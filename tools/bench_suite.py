@@ -1,0 +1,121 @@
+"""BASELINE-table benchmark suite: one JSON line per headline config.
+
+Covers the target rows in BASELINE.md beyond the single-number contract
+of ``bench.py``:
+
+* iso3dfd order-16, single device (jit vs tuned pallas);
+* cube/9axis 27-point with temporal wave-front fusion (wavefront
+  speedup = fused K>1 over K=1);
+* ssg staggered elastic (multi-var);
+* awp, domain-decomposed with measured halo fraction (multi-device).
+
+Sizes shrink automatically off-TPU so the suite stays runnable on the
+virtual CPU mesh for plumbing validation.
+
+Run: ``python tools/bench_suite.py``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(ctx, g_pts, steps, trials=3):
+    rates = []
+    t = ctx._cur_step
+    ctx.run_solution(t, t + steps - 1)   # warm
+    t += steps
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        ctx.run_solution(t, t + steps - 1)
+        dt = time.perf_counter() - t0
+        t += steps
+        rates.append(g_pts * steps / dt / 1e9)
+    rates.sort()
+    return rates[len(rates) // 2]
+
+
+def build(fac, env, name, radius, g, mode, wf=0, ranks=(), measure_halo=False):
+    from yask_tpu.runtime.init_utils import init_solution_vars
+    ctx = fac.new_solution(env, stencil=name, radius=radius)
+    opts = f"-g {g} -wf_steps {wf}"
+    if measure_halo:
+        opts += " -measure_halo"
+    ctx.apply_command_line_options(opts)
+    ctx.get_settings().mode = mode
+    for d, r in ranks:
+        ctx.set_num_ranks(d, r)
+    ctx.prepare_solution()
+    init_solution_vars(ctx)
+    return ctx
+
+
+def emit(metric, value, unit, **extra):
+    print(json.dumps({"metric": metric, "value": round(value, 4),
+                      "unit": unit, **extra}), flush=True)
+
+
+def main() -> int:
+    from yask_tpu import yk_factory
+    fac = yk_factory()
+    env = fac.new_env()
+    plat = env.get_platform()
+    on_tpu = plat == "tpu"
+    ndev = env.get_num_ranks()
+
+    g = 512 if on_tpu else 48
+    steps = 10 if on_tpu else 2
+
+    # 1) iso3dfd order-16 single device: jit, then pallas
+    ctx = build(fac, env, "iso3dfd", 8, g, "jit")
+    rate = measure(ctx, g ** 3, steps)
+    emit(f"iso3dfd r=8 {g}^3 {plat} jit", rate, "GPts/s")
+    try:
+        p = build(fac, env, "iso3dfd", 8, g, "pallas", wf=2)
+        rate_p = measure(p, g ** 3, steps)
+        emit(f"iso3dfd r=8 {g}^3 {plat} pallas-K2", rate_p, "GPts/s")
+    except Exception as e:
+        emit(f"iso3dfd r=8 {g}^3 {plat} pallas-K2", 0.0, "GPts/s",
+             error=str(e)[:120])
+
+    # 2) cube 27-pt wave-front speedup (fused K4 over K1)
+    gc = 256 if on_tpu else 32
+    try:
+        base = measure(build(fac, env, "cube", 1, gc, "pallas", wf=1),
+                       gc ** 3, steps)
+        fused = measure(build(fac, env, "cube", 1, gc, "pallas", wf=4),
+                        gc ** 3, steps)
+        emit(f"cube 27pt {gc}^3 {plat} wavefront-speedup",
+             fused / max(base, 1e-12), "x", k1_gpts=round(base, 4),
+             k4_gpts=round(fused, 4))
+    except Exception as e:
+        emit(f"cube 27pt {gc}^3 {plat} wavefront-speedup", 0.0, "x",
+             error=str(e)[:120])
+
+    # 3) ssg staggered elastic
+    gs = 256 if on_tpu else 32
+    ctx = build(fac, env, "ssg", 2, gs, "jit")
+    emit(f"ssg r=2 {gs}^3 {plat} jit", measure(ctx, gs ** 3, steps),
+         "GPts/s")
+
+    # 4) awp domain-decomposed + halo fraction (needs >1 device)
+    if ndev > 1:
+        ga = 256 if on_tpu else 32
+        ctx = build(fac, env, "awp", None, ga, "shard_map",
+                    ranks=[("x", ndev)], measure_halo=True)
+        rate = measure(ctx, ga ** 3, steps)
+        st = ctx.get_stats()
+        halo_pct = (100.0 * st.get_halo_secs()
+                    / max(st.get_elapsed_secs(), 1e-12))
+        emit(f"awp {ga}^3 {plat} x{ndev} shard_map", rate, "GPts/s",
+             halo_pct=round(halo_pct, 2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
