@@ -96,3 +96,26 @@ def test_fuzzed_solution_jit_matches_oracle(seed):
         p = run("pallas")
         bad = p.compare_data(b, epsilon=1e-3, abs_epsilon=1e-4)
         assert bad == 0, f"seed {seed} (pallas): {bad} mismatches"
+
+    # ...and the explicit distributed path (scratch/misc structures
+    # through the ghost-exchange planner), BOTH refresh hooks: the
+    # overlap split and the plain per-stage hook
+    dims = soln.domain_dim_names()
+    if len(dims) >= 2:
+        def run_sharded(overlap):
+            env2 = yk_factory().new_env()
+            ctx = yk_factory().new_solution(env2, soln)
+            ctx.apply_command_line_options("-g 10")
+            ctx.get_settings().mode = "shard_map"
+            ctx.get_settings().overlap_comms = overlap
+            ctx.set_num_ranks(dims[0], 2)
+            ctx.prepare_solution()
+            from yask_tpu.runtime.init_utils import init_solution_vars
+            init_solution_vars(ctx, seed=0.03)
+            ctx.run_solution(0, 2)
+            return ctx
+        for overlap in (True, False):
+            sm = run_sharded(overlap)
+            bad = sm.compare_data(b, epsilon=1e-3, abs_epsilon=1e-4)
+            assert bad == 0, \
+                f"seed {seed} (shard_map overlap={overlap}): {bad}"

@@ -360,3 +360,49 @@ def test_reverse_time_distributed(env):
         c = run(mode, wf=wf, ranks=ranks)
         assert c.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0, \
             (mode, wf)
+
+
+def test_ring_reads_of_computed_var_refresh(env):
+    """Regression (fuzz seed 1007): when a later stage reads an
+    earlier-stage-COMPUTED var's previous-step ring values with ghost
+    offsets, the refresh must exchange the ring slot too — exchanging
+    only the computed array rotates stale shard ghosts into the next
+    step (overlap path)."""
+    from yask_tpu.compiler.solution import yc_factory
+
+    def build():
+        soln = yc_factory().new_solution("ringref")
+        t = soln.new_step_index("t")
+        x = soln.new_domain_index("x")
+        y = soln.new_domain_index("y")
+        a = soln.new_var("a", [t, x, y])
+        b = soln.new_var("b", [t, x, y])
+        s = soln.new_scratch_var("s", [x, y])
+        # stage 0: conditional writer of a
+        a(t + 1, x, y).EQUALS(a(t, x, y) * 0.5 + 0.1).IF_DOMAIN(x >= 3)
+        # stage 1: scratch reads a's PREVIOUS-step ring values with
+        # offsets; b consumes the scratch at an offset
+        s(x, y).EQUALS(a(t, x - 1, y) + a(t - 1, x + 1, y))
+        b(t + 1, x, y).EQUALS(s(x + 2, y) + b(t, x, y) * 0.5
+                              + a(t + 1, x - 1, y))
+        return soln
+
+    def run(mode, overlap=True, ranks=()):
+        ctx = yk_factory().new_solution(env, build())
+        ctx.apply_command_line_options("-g 16")
+        ctx.get_settings().mode = mode
+        ctx.get_settings().overlap_comms = overlap
+        for d, r in ranks:
+            ctx.set_num_ranks(d, r)
+        ctx.prepare_solution()
+        for n in ("a", "b"):
+            ctx.get_var(n).set_elements_in_seq(0.1)
+        ctx.run_solution(0, 3)
+        return ctx
+
+    ref = run("ref")
+    for overlap in (True, False):
+        for ranks in ([("x", 2)], [("y", 4)], [("x", 2), ("y", 2)]):
+            sm = run("shard_map", overlap=overlap, ranks=ranks)
+            bad = sm.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4)
+            assert bad == 0, (overlap, ranks, bad)

@@ -431,15 +431,19 @@ class SolutionAnalysis:
 
     # ------------------------------------------------------------------
 
-    def stage_read_widths(self) -> List[Dict[str, Dict[str, Tuple[int, int]]]]:
-        """Per stage: vars (non-scratch) read with nonzero domain offsets
-        and the (left, right) ghost widths needed, with reads made by
-        scratch-writing equations widened by the scratch write-halo. Drives
-        both the distributed exchange planner and the Pallas per-stage
-        margin accounting."""
-        out: List[Dict[str, Dict[str, Tuple[int, int]]]] = []
+    def stage_read_widths_split(self) -> List[Dict[str, Dict]]:
+        """Per stage, ghost widths split by which BUFFER the read hits:
+        ``"computed"`` — reads at the written step offset (this step's
+        output, an earlier stage's `computed` array); ``"ring"`` — every
+        other read (previous-step ring slots, read-only vars). The
+        distributed refresh must exchange BOTH when a stage does both —
+        a later stage can read an already-computed var's previous-step
+        ring values with ghost offsets, and refreshing only the computed
+        array leaves the ring slot (which the rotation carries into the
+        next step) with stale shard ghosts."""
+        out: List[Dict[str, Dict]] = []
         for stage in self.stages:
-            reads: Dict[str, Dict[str, Tuple[int, int]]] = {}
+            kinds = {"ring": {}, "computed": {}}
             for part in stage.parts:
                 for eq in part.eqs:
                     lhs_wh = self.scratch_write_halo.get(
@@ -448,15 +452,41 @@ class SolutionAnalysis:
                         v = p.get_var()
                         if v.is_scratch():
                             continue
-                        entry = reads.setdefault(v.get_name(), {})
+                        so = p.step_offset()
+                        kind = "computed" if (so is not None
+                                              and so == self.step_dir
+                                              and v.is_written) else "ring"
+                        entry = kinds[kind].setdefault(v.get_name(), {})
                         for d, ofs in p.domain_offsets().items():
                             wl, wr = lhs_wh.get(d, (0, 0))
                             l, r = entry.get(d, (0, 0))
                             entry[d] = (max(l, wl - min(ofs, 0)),
                                         max(r, wr + max(ofs, 0)))
-            reads = {k: {d: lr for d, lr in vv.items() if lr != (0, 0)}
-                     for k, vv in reads.items()}
-            out.append({k: vv for k, vv in reads.items() if vv})
+            for kind in kinds:
+                kinds[kind] = {
+                    k: {d: lr for d, lr in vv.items() if lr != (0, 0)}
+                    for k, vv in kinds[kind].items()}
+                kinds[kind] = {k: vv for k, vv in kinds[kind].items()
+                               if vv}
+            out.append(kinds)
+        return out
+
+    def stage_read_widths(self) -> List[Dict[str, Dict[str, Tuple[int, int]]]]:
+        """Per stage: vars (non-scratch) read with nonzero domain offsets
+        and the (left, right) ghost widths needed — the UNION over both
+        read kinds of :meth:`stage_read_widths_split`. Drives the Pallas
+        per-stage margin accounting and the overlap split's core shrink;
+        the exchange planner uses the split form."""
+        out: List[Dict[str, Dict[str, Tuple[int, int]]]] = []
+        for kinds in self.stage_read_widths_split():
+            reads: Dict[str, Dict[str, Tuple[int, int]]] = {}
+            for kind in ("ring", "computed"):
+                for vname, widths in kinds[kind].items():
+                    entry = reads.setdefault(vname, {})
+                    for d, (l, r) in widths.items():
+                        cl, cr = entry.get(d, (0, 0))
+                        entry[d] = (max(cl, l), max(cr, r))
+            out.append(reads)
         return out
 
     def fused_step_radius(self) -> Dict[str, int]:

@@ -296,7 +296,18 @@ class StepProgram:
         # Stage metadata for halo exchange / fused-tile margin accounting
         # (the dirty-width analog of the reference's per-var dirty flags,
         # yk_var.hpp:564; see SolutionAnalysis.stage_read_widths).
-        self.stage_reads = self.ana.stage_read_widths()
+        # one equation scan: the union form derives from the split form
+        self.stage_reads_split = self.ana.stage_read_widths_split()
+        self.stage_reads = []
+        for kinds in self.stage_reads_split:
+            reads: Dict[str, Dict[str, Tuple[int, int]]] = {}
+            for kind in ("ring", "computed"):
+                for vname, widths in kinds[kind].items():
+                    entry = reads.setdefault(vname, {})
+                    for d, (l, r) in widths.items():
+                        cl, cr = entry.get(d, (0, 0))
+                        entry[d] = (max(cl, l), max(cr, r))
+            self.stage_reads.append(reads)
 
     # -- state construction ------------------------------------------------
 

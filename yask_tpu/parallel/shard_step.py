@@ -67,6 +67,22 @@ def exchange_ghosts(arr, geom, dim_widths: Dict[str, Tuple[int, int]],
     return arr
 
 
+def _widen(applied: Dict, key, widths: Dict[str, Tuple[int, int]]):
+    """Track the union of exchanged ghost widths per buffer: returns
+    (union, grew) where ``grew`` means this refresh must actually run —
+    a later stage reading the same buffer with WIDER ghosts re-exchanges
+    the union, not the narrow refresh. Shared by both shard paths'
+    refresh hooks so the tracking cannot drift."""
+    out = dict(applied.get(key, {}))
+    grew = key not in applied
+    for d, (l, r) in widths.items():
+        al, ar = out.get(d, (0, 0))
+        if l > al or r > ar:
+            grew = True
+        out[d] = (max(al, l), max(ar, r))
+    return out, grew
+
+
 def _no_exchange(arr, geom, dim_widths, nr, local_sizes):
     """Exchange stand-in for halo-time calibration: the compiled twin with
     this in place of ``exchange_ghosts`` differs from the real program
@@ -111,32 +127,31 @@ def _make_overlap_step(prog, nr, lsizes, exchange=exchange_ghosts):
         ring_w: Dict[str, Dict[str, Tuple[int, int]]] = {}
         post_w: Dict[str, Dict[str, Tuple[int, int]]] = {}
 
-        def widen(applied, widths):
-            out = dict(applied)
-            grew = False
-            for d, (l, r) in widths.items():
-                al, ar = out.get(d, (0, 0))
-                if l > al or r > ar:
-                    grew = True
-                out[d] = (max(al, l), max(ar, r))
-            return out, grew
-
         for si in range(len(ana.stages)):
             reads = prog.stage_reads[si]
-            # refresh ghosts (post versions) for this stage's inputs
-            for vname, widths in reads.items():
+            split = prog.stage_reads_split[si]
+            # refresh ghosts (post versions) for this stage's inputs —
+            # BOTH buffers a read can hit: the computed (this-step)
+            # array of an earlier stage, and the newest ring slot for
+            # previous-step reads (a var can need both; refreshing only
+            # computed would rotate stale ghosts into the next step)
+            for vname, widths in split["computed"].items():
                 g = prog.geoms[vname]
                 if not any(nr.get(d, 1) > 1 for d in widths):
                     continue
                 if vname in computed:
-                    union, grew = widen(post_w.get(vname, {}), widths)
+                    union, grew = _widen(post_w, vname, widths)
                     if vname not in computed_post or grew:
                         computed_post[vname] = exchange(
                             computed[vname], g, union, nr, lsizes)
                         post_w[vname] = union
-                elif g.is_written and g.has_step:
-                    union, grew = widen(ring_w.get(vname, {}), widths)
-                    if vname not in ring_w or grew:
+            for vname, widths in split["ring"].items():
+                g = prog.geoms[vname]
+                if not any(nr.get(d, 1) > 1 for d in widths):
+                    continue
+                if g.is_written and g.has_step:
+                    union, grew = _widen(ring_w, vname, widths)
+                    if grew:
                         ring = list(state_post[vname])
                         ring[-1] = exchange(ring[-1], g, union, nr,
                                             lsizes)
@@ -371,36 +386,34 @@ def run_shard_map(ctx, start: int, n: int) -> None:
                 # wider ghost reads re-exchanges the union
                 applied = {}
 
-                def union_of(key, widths):
-                    out = dict(applied.get(key, {}))
-                    grew = key not in applied
-                    for d, (l, r) in widths.items():
-                        al, ar = out.get(d, (0, 0))
-                        if l > al or r > ar:
-                            grew = True
-                        out[d] = (max(al, l), max(ar, r))
-                    return out, grew
-
                 def hook(si, state_, computed):
-                    reads = prog.stage_reads[si]
-                    for vname, widths in reads.items():
+                    # refresh BOTH buffers a stage's reads can hit (see
+                    # stage_read_widths_split: refreshing only the
+                    # computed array would leave previous-step ring
+                    # reads of the same var with stale shard ghosts)
+                    split = prog.stage_reads_split[si]
+                    for vname, widths in split["computed"].items():
+                        if vname not in computed:
+                            continue
                         g2 = prog.geoms[vname]
-                        if vname in computed:
-                            u, grew = union_of((vname, "c"), widths)
-                            if grew:
-                                computed = {**computed,
-                                            vname: exchange(
-                                                computed[vname], g2, u,
-                                                nr, lsizes)}
-                                applied[(vname, "c")] = u
-                        elif g2.is_written and g2.has_step:
-                            u, grew = union_of((vname, "s"), widths)
-                            if grew:
-                                ring = list(state_[vname])
-                                ring[-1] = exchange(
-                                    ring[-1], g2, u, nr, lsizes)
-                                state_ = {**state_, vname: ring}
-                                applied[(vname, "s")] = u
+                        u, grew = _widen(applied, (vname, "c"), widths)
+                        if grew:
+                            computed = {**computed,
+                                        vname: exchange(
+                                            computed[vname], g2, u,
+                                            nr, lsizes)}
+                            applied[(vname, "c")] = u
+                    for vname, widths in split["ring"].items():
+                        g2 = prog.geoms[vname]
+                        if not (g2.is_written and g2.has_step):
+                            continue
+                        u, grew = _widen(applied, (vname, "s"), widths)
+                        if grew:
+                            ring = list(state_[vname])
+                            ring[-1] = exchange(
+                                ring[-1], g2, u, nr, lsizes)
+                            state_ = {**state_, vname: ring}
+                            applied[(vname, "s")] = u
                     return state_, computed
 
                 return prog.step(st, t, halo_hook=hook)
