@@ -36,7 +36,9 @@ def random_solution(rng, idx):
         if depth > 2 or r < 0.3:
             v = vars_[rng.randint(nvars)]
             offs = [int(rng.randint(-2, 3)) for _ in dims]
-            so = 0 if rng.rand() < 0.8 else -1
+            rr = rng.rand()
+            # mostly newest-slot reads; sometimes t-1, rarely t-2
+            so = 0 if rr < 0.75 else (-1 if rr < 0.93 else -2)
             args = [t + so] + [d + o for d, o in zip(dims, offs)]
             p = v(*args)
             return p
@@ -65,6 +67,11 @@ def random_solution(rng, idx):
         eq = v(t + 1, *dims).EQUALS(rhs)
         if rng.rand() < 0.3 and len(dims) >= 1:
             eq.IF_DOMAIN(dims[0] >= 3)
+        elif rng.rand() < 0.15:
+            # step-parity condition: unselected points keep evicted-slot
+            # values, exercising deep-ring base semantics per mode
+            eq.IF_STEP((t % 2) == 0)
+            v(t + 1, *dims).EQUALS(v(t, *dims) * 0.9).IF_STEP((t % 2) == 1)
     return soln
 
 
