@@ -274,3 +274,39 @@ def test_shard_state_stays_device_resident(env):
         # and a var write after materialization still round-trips
         ctx.get_var("A").set_element(2.5, [4, 7, 7, 7])
         assert ctx.get_var("A").get_element([4, 7, 7, 7]) == 2.5
+
+
+def test_vars_in_constructor_pattern_runs_define(env):
+    """The reference's canonical pattern — vars created in the
+    constructor, equations in define() (Iso3dfdStencil's MAKE_VAR
+    members) — must not be treated as already-defined (ADVICE r2:
+    a silent zero-equation no-op)."""
+    from yask_tpu.compiler.solution_base import yc_solution_base
+
+    class VarsInCtor(yc_solution_base):
+        def __init__(self):
+            super().__init__("vars_in_ctor_test")
+            self._t = self.new_step_index("t")
+            self._x = self.new_domain_index("x")
+            self.A = self.new_var("A", [self._t, self._x])
+
+        def define(self):
+            t, x = self._t, self._x
+            self.A(t + 1, x).EQUALS(self.A(t, x) + 1.0)
+
+    s = VarsInCtor()
+    s.run_define()
+    assert s.get_soln().get_num_equations() == 1
+    s.run_define()   # idempotent
+    assert s.get_soln().get_num_equations() == 1
+
+
+def test_direct_define_call_not_rerun():
+    """A user may call define() directly before handing the object to
+    the runtime; run_define must then not re-run it (vars-only
+    solutions would raise duplicate-var on the second pass)."""
+    from yask_tpu.stencils.test_stencils import TestEmpty2d
+    s = TestEmpty2d()
+    s.define()          # creates var A, zero equations
+    s.run_define()      # must be a no-op, not a duplicate-var error
+    assert len(s.get_soln().get_vars()) == 1

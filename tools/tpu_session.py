@@ -41,11 +41,17 @@ def log(stage, **kv):
     print(json.dumps({"stage": stage, **kv}), flush=True)
 
 
-def build(fac, env, name, mode, g, radius, wf=1, block=None):
+def build(fac, env, name, mode, g, radius, wf=1, block=None, tune=False):
     from yask_tpu.runtime.init_utils import init_solution_vars
     ctx = fac.new_solution(env, stencil=name, radius=radius)
     ctx.apply_command_line_options(f"-g {g} -wf_steps {wf}")
     ctx.get_settings().mode = mode
+    if tune:
+        # Must be set BEFORE prepare: pallas pads are then planned for
+        # tune_max_wf_steps so the joint walk can grow K, not only
+        # shrink it (K-doubling candidates would otherwise all fail pad
+        # validation and cache as inf).
+        ctx.get_settings().do_auto_tune = True
     if block:
         for d, b in block.items():
             ctx.set_block_size(d, b)
@@ -117,10 +123,13 @@ def main(argv=None) -> int:
         extra_pad={"x": (16, 16), "y": (16, 16), "z": (0, 0)})
     state = prog.alloc_state()
     interp = plat != "tpu"   # only under YT_TPU_SESSION_FORCE
+    from yask_tpu.ops.pallas_stencil import default_vmem_budget
+    budget = default_vmem_budget(plat)
     for pipe in (False, True):
         chunk, tb = build_pallas_chunk(prog, fuse_steps=2,
                                        pipeline_dmas=pipe,
-                                       interpret=interp)
+                                       interpret=interp,
+                                       vmem_budget=budget)
         fn = chunk if interp else jax.jit(chunk).lower(state, 0).compile()
         st = fn(state, 0)
         jax.block_until_ready(st)
@@ -135,7 +144,7 @@ def main(argv=None) -> int:
 
     # 4) joint auto-tune at the bench size
     from yask_tpu.runtime.auto_tuner import AutoTuner
-    ctx = build(fac, env, "iso3dfd", "pallas", g_bench, 8, wf=2)
+    ctx = build(fac, env, "iso3dfd", "pallas", g_bench, 8, wf=2, tune=True)
     ctx.get_settings().auto_tune_trial_secs = 0.5
     tuner = AutoTuner(ctx)
     best_k = tuner.run_auto_tuner_now()

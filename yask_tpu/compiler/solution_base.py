@@ -70,13 +70,37 @@ class yc_solution_base:
         self._nfac = yc_node_factory()
         self._defined = False
 
+    def __init_subclass__(cls, **kwargs):
+        """Wrap each subclass's ``define()`` so ANY successful call —
+        including a user calling ``s.define()`` directly before handing
+        the object to the runtime — marks the solution defined. This is
+        what lets ``run_define`` key purely off the flag/equations
+        without re-running ``define()`` (which would raise duplicate-var
+        for vars-only solutions) and without mistaking constructor-made
+        vars for a completed definition (ADVICE r2: the reference's
+        canonical vars-in-constructor pattern must still run define)."""
+        super().__init_subclass__(**kwargs)
+        if "define" in cls.__dict__:
+            import functools
+            orig = cls.__dict__["define"]
+
+            @functools.wraps(orig)
+            def define(self, *a, **kw):
+                r = orig(self, *a, **kw)
+                self._defined = True
+                return r
+            cls.define = define
+
     def run_define(self) -> None:
-        """Run ``define()`` exactly once. Content (vars or equations)
-        also counts as already-defined so user code that called
-        ``define()`` directly keeps working; the explicit flag covers
-        legal zero-content solutions (test_empty family)."""
-        if self._defined or self._soln.get_num_equations() > 0 \
-                or self._soln.get_vars():
+        """Run ``define()`` exactly once. Only prior *equations* (or the
+        explicit flag) count as already-defined: vars alone must not —
+        the reference's canonical pattern creates vars in the
+        constructor and equations in ``define()`` (Iso3dfdStencil's
+        MAKE_VAR members), and treating those vars as "defined" would
+        silently skip ``define()`` and run a no-op solution. Legal
+        zero-equation solutions (test_empty family) are covered by the
+        flag, set after their (empty-ish) ``define()`` runs."""
+        if self._defined or self._soln.get_num_equations() > 0:
             self._defined = True
             return
         self.define()

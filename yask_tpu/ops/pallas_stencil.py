@@ -266,6 +266,15 @@ class _TileEval:
 # ---------------------------------------------------------------------------
 
 
+def default_vmem_budget(platform: str) -> int:
+    """Device-derived Pallas VMEM budget: ~16 MiB/core on real TPU (the
+    hardware guide's figure; overridable via ``-vmem_mb``), a loose
+    100 MiB under CPU interpret where VMEM is emulated and the budget
+    only shapes planning. Single definition for the runtime context,
+    harness tools, and bench."""
+    return 16 * 2 ** 20 if platform == "tpu" else 100 * 2 ** 20
+
+
 def build_pallas_chunk(program, fuse_steps: int = 1,
                        block: Optional[Tuple[int, ...]] = None,
                        interpret: bool = False,

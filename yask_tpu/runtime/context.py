@@ -278,6 +278,12 @@ class StencilContext:
                     f"solution '{self.get_name()}' cannot use the pallas "
                     f"path: {why}; use -mode jit")
             K = max(self._opts.wf_steps, 1)
+            if self._opts.do_auto_tune:
+                # Plan pads for the largest K the joint walk may try so
+                # the tuner can grow K, not only shrink it (the pads are
+                # zero-filled and cheap; without this every K-doubling
+                # candidate fails pad validation and caches as inf).
+                K = max(K, self._opts.tune_max_wf_steps)
             step_rad = self._ana.fused_step_radius()
             for d in self._ana.domain_dims[:-1]:
                 need = step_rad.get(d, 0) * K
@@ -437,7 +443,8 @@ class StencilContext:
                 h(self)
             return
 
-        if self._opts.do_auto_tune and self._mode in ("jit", "sharded"):
+        if self._opts.do_auto_tune and self._mode in (
+                "jit", "sharded", "pallas", "shard_pallas"):
             from yask_tpu.runtime.auto_tuner import AutoTuner
             AutoTuner(self).tune_if_needed()
 
@@ -539,6 +546,17 @@ class StencilContext:
             jax.block_until_ready(st)
         self._state = st
 
+    def vmem_budget(self) -> int:
+        """Pallas VMEM budget in bytes: the ``-vmem_mb`` knob, or a
+        device-derived default (~16 MiB/core on real TPU, a loose
+        100 MiB under CPU interpret where VMEM is emulated and the
+        budget only shapes planning)."""
+        mb = self._opts.vmem_budget_mb
+        if mb > 0:
+            return mb * 2 ** 20
+        from yask_tpu.ops.pallas_stencil import default_vmem_budget
+        return default_vmem_budget(self._env.get_platform())
+
     def _get_pallas_chunk(self, K: int):
         """Compiled fused-Pallas chunk for K steps with the current block
         settings (cached per (K, block) — the auto-tuner varies both)."""
@@ -553,7 +571,8 @@ class StencilContext:
             from yask_tpu.ops.pallas_stencil import build_pallas_chunk
             interp = self._env.get_platform() != "tpu"
             chunk, tile_bytes = build_pallas_chunk(
-                self._program, fuse_steps=K, block=blk, interpret=interp)
+                self._program, fuse_steps=K, block=blk, interpret=interp,
+                vmem_budget=self.vmem_budget())
             self._state_to_device()
             t0c = time.perf_counter()
             if interp:

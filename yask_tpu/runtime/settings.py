@@ -62,6 +62,15 @@ class KernelSettings:
         self.do_auto_tune = False
         self.auto_tune_each_stage = False
         self.auto_tune_trial_secs = 0.5
+        # Largest wf_steps the joint walk may try. When auto-tune is on,
+        # pallas-mode pads are planned up to radius × this at prepare
+        # time so the walk can *grow* K, not only shrink it.
+        self.tune_max_wf_steps = 16
+        # Pallas VMEM budget in MiB (0 = auto: ~16 MiB/core on real TPU
+        # per the hardware guide, a loose 100 MiB under CPU interpret
+        # where VMEM is emulated). The reference exposes every size knob
+        # via CLI (settings.hpp:200-327); this is the TPU-side analog.
+        self.vmem_budget_mb = 0
         # Misc.
         self.max_threads = 0           # accepted for parity; XLA manages
         self.numa_pref = -1            # accepted for parity
@@ -113,6 +122,13 @@ class KernelSettings:
         parser.add_bool_option(
             "auto_tune", "Auto-tune tile sizes during the run.", self,
             "do_auto_tune")
+        parser.add_int_option(
+            "tune_max_wf_steps", "Largest wf_steps the auto-tuner may "
+            "try (pallas pads are pre-planned to cover it).", self,
+            "tune_max_wf_steps")
+        parser.add_int_option(
+            "vmem_mb", "Pallas VMEM budget in MiB (0 = derive from the "
+            "device).", self, "vmem_budget_mb")
         parser.add_int_option(
             "max_threads", "Accepted for reference parity.", self,
             "max_threads")
