@@ -153,6 +153,49 @@ def try_pallas(fac, env, g, steps_per_trial, trials, candidates=(2, 4)):
     return best
 
 
+def _run_suite_rows():
+    """The BASELINE-table rows beyond the headline (cube wavefront
+    speedup, ssg, awp + halo %, pallas-K2): printed as JSON lines BEFORE
+    the contract line (which stays last for the driver's parser);
+    ``tools/bench_suite.py`` also persists them to
+    BENCH_suite_latest.json so the round artifact records the suite, not
+    one number (VERDICT r2 weak 6).
+
+    Runs in a subprocess under a hard (process-group) kill so a hung
+    section can never forfeit the already-measured contract line — the
+    same isolation pattern as ``_probe_platform``. Never fatal."""
+    if os.environ.get("YT_BENCH_SUITE", "1") != "1":
+        return
+    budget = float(os.environ.get("YT_SUITE_BUDGET", "900"))
+    suite = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools", "bench_suite.py")
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, suite], stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, start_new_session=True)
+        try:
+            out, _ = proc.communicate(timeout=budget)
+        except subprocess.TimeoutExpired:
+            import signal
+            os.killpg(proc.pid, signal.SIGKILL)
+            try:
+                # drain the rows measured before the hang — a partial
+                # suite beats losing everything to the kill
+                out, _ = proc.communicate(timeout=5)
+            except Exception:
+                out = ""
+            out = (out or "") + "\n" + json.dumps(
+                {"metric": "bench_suite timeout", "value": 0.0,
+                 "unit": "error"})
+        for line in (out or "").splitlines():
+            if line.strip():
+                print(line, flush=True)
+    except Exception as e:
+        print(json.dumps({"metric": "bench_suite failed", "value": 0.0,
+                          "unit": "error", "error": str(e)[:160]}),
+              flush=True)
+
+
 def main():
     if _probe_platform() is None:
         # default backend unreachable (relay down): run the bench on CPU
@@ -198,6 +241,7 @@ def main():
                 p = try_pallas(fac, env, g, steps_per_trial, trials)
                 if p is not None and p[0] > rate:
                     rate, mode = p[0], f"pallas-K{p[1]}"
+            _run_suite_rows()
             print(json.dumps({
                 "metric": f"iso3dfd r=8 {g}^3 fp32 {platform} "
                           f"throughput ({mode})",

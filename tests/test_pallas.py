@@ -290,3 +290,32 @@ def test_apply_best_skips_infeasible():
     t.results = {(8,): float("inf"), (16,): float("inf")}
     t.apply_best()
     assert FakeCtx._opts.wf_steps == 2
+
+
+def test_tuned_pad_replan_shrinks_and_migrates(env):
+    """After tuning, pads pre-planned for tune_max_wf_steps shrink to
+    radius×K and the state migrates exactly (the tuner must not tax
+    every ring slot's HBM footprint forever)."""
+    def mk(mode, tune):
+        ctx = yk_factory().new_solution(env, stencil="iso3dfd", radius=2)
+        ctx.apply_command_line_options("-g 32")
+        st = ctx.get_settings()
+        st.mode = mode
+        if tune:
+            st.do_auto_tune = True
+            st.tune_max_wf_steps = 8
+        ctx.prepare_solution()
+        ctx.get_var("pressure").set_element(1.0, [0, 16, 16, 16])
+        ctx.get_var("vel").set_all_elements_same(0.001)
+        return ctx
+
+    ctx = mk("pallas", tune=True)
+    assert ctx._program.geoms["pressure"].pads["x"] == (18, 18)
+    ctx.get_settings().wf_steps = 2
+    ctx._tuned = True
+    ctx._replan_pallas_pads(2)
+    assert ctx._program.geoms["pressure"].pads["x"] == (6, 6)
+    ctx.run_solution(0, 3)
+    ref = mk("jit", tune=False)
+    ref.run_solution(0, 3)
+    assert ctx.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0

@@ -246,6 +246,15 @@ class VarGeom:
                 self.misc_lo[n] = lo
                 self.shape.append(hi - lo + 1)
 
+    @property
+    def num_slots(self) -> int:
+        """Ring slots allocated in state: write-back-optimized alloc for
+        written step vars, one slot otherwise. THE single definition —
+        shard_map in_specs, pallas ring handling, and tile planning must
+        all agree with ``alloc_state`` or the shard pytree structure
+        desynchronizes from the state rings at trace time."""
+        return self.alloc if (self.has_step and self.is_written) else 1
+
     def axis_of(self, dim: str) -> int:
         for i, (n, _) in enumerate(self.axes):
             if n == dim:
@@ -319,7 +328,7 @@ class StepProgram:
         for name, g in self.geoms.items():
             if g.is_scratch:
                 continue
-            nslots = g.alloc if (g.has_step and g.is_written) else 1
+            nslots = g.num_slots
             arrs = []
             for _ in range(nslots):
                 if init and name in init:

@@ -806,5 +806,5 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
 
 def program_state_slots(program, name: str) -> List[int]:
     g = program.geoms[name]
-    n = g.alloc if (g.has_step and g.is_written) else 1
+    n = g.num_slots
     return list(range(n))

@@ -67,7 +67,7 @@ def plan_blocks(program, fuse_steps: int = 1,
     nbuf = 0
     minor_ext = 1
     for n, g in program.geoms.items():
-        slots = g.alloc if (g.has_step and g.is_written) else 1
+        slots = g.num_slots
         # misc axes ride whole in every tile: they multiply the buffer
         # count, or the VMEM estimate undershoots (box/gaussian channel
         # dims) and the kernel's exact accounting rejects the plan

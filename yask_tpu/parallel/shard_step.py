@@ -506,7 +506,7 @@ def run_shard_map(ctx, start: int, n: int) -> None:
 def _prep_shard_pallas(ctx, n: int, K: int, blk):
     """Validate + plan one ``(n, K, blk)`` shard_pallas variant.
 
-    Returns ``(names, slots, specs_for, build)`` where ``build(exchange)``
+    Returns ``(names, specs_for, build)`` where ``build(exchange)``
     is the un-jitted shard_map program (``exchange`` selects the real
     ghost exchange or the no-exchange calibration twin). Raises
     ``YaskException`` for infeasible candidates (minor-dim sharding at
@@ -547,10 +547,7 @@ def _prep_shard_pallas(ctx, n: int, K: int, blk):
                                 extra_pad=extra)
 
     names = [k for k, g in ctx._program.geoms.items() if not g.is_scratch]
-    slots = {k: (ctx._program.geoms[k].alloc
-                 if (ctx._program.geoms[k].has_step
-                     and ctx._program.geoms[k].is_written) else 1)
-             for k in names}
+    slots = {k: ctx._program.geoms[k].num_slots for k in names}
     specs_for = _make_specs_for(local_prog, nr)
 
     groups, rem = divmod(n, K)
@@ -670,7 +667,7 @@ def _prep_shard_pallas(ctx, n: int, K: int, blk):
             return shard_map(body, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_rep=False)
 
-    return names, slots, specs_for, build
+    return names, specs_for, build
 
 
 def get_shard_pallas_fn(ctx, interior, start: int, n: int, K: int, blk,
@@ -690,7 +687,7 @@ def get_shard_pallas_fn(ctx, interior, start: int, n: int, K: int, blk,
     key = ("shard_pallas", n, K, blk)
     if key not in ctx._jit_cache:
         if build is None:
-            _, _, _, build = _prep_shard_pallas(ctx, n, K, blk)
+            _, _, build = _prep_shard_pallas(ctx, n, K, blk)
         t0c = time.perf_counter()
         ctx._jit_cache[key] = \
             jax.jit(build(exchange_ghosts), donate_argnums=0) \
@@ -740,7 +737,7 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
     need_cal = (opts.measure_halo_time and key not in ctx._halo_frac)
     build = None
     if need_build or need_cal:
-        names, _, specs_for, build = _prep_shard_pallas(ctx, n, K, blk)
+        names, specs_for, build = _prep_shard_pallas(ctx, n, K, blk)
     else:
         names, specs_for = _prep_names_specs(ctx, nr)
 

@@ -557,6 +557,54 @@ class StencilContext:
         from yask_tpu.ops.pallas_stencil import default_vmem_budget
         return default_vmem_budget(self._env.get_platform())
 
+    def _replan_pallas_pads(self, k: int) -> None:
+        """Shrink pallas pads back to radius×k after the tuner settles.
+
+        Pads were pre-planned for ``tune_max_wf_steps`` so the joint
+        walk could *grow* K; keeping them would tax every ring slot's
+        HBM footprint forever (e.g. radius 8 × Kmax 16 = 128 cells per
+        side). Interiors are migrated into right-sized arrays (pads stay
+        identically zero — the framework invariant) and the jit cache is
+        cleared: compiled chunks are shape-keyed, so the tuned point
+        recompiles once at production shape. Note a later
+        ``reset_auto_tuner`` re-tune can then only shrink K again."""
+        if self._mode != "pallas":
+            return
+        extra = {d: (self._opts.min_pad_sizes[d],
+                     self._opts.min_pad_sizes[d])
+                 for d in self._ana.domain_dims}
+        step_rad = self._ana.fused_step_radius()
+        for d in self._ana.domain_dims[:-1]:
+            need = step_rad.get(d, 0) * max(k, 1)
+            l, r = extra[d]
+            extra[d] = (max(l, need), max(r, need))
+        if extra == self._plan_kwargs.get("extra_pad"):
+            return
+        import jax.numpy as jnp
+        gsz = self._opts.global_domain_sizes
+        new_kwargs = dict(self._plan_kwargs, extra_pad=extra)
+        new_prog = self._csol.plan(gsz, **new_kwargs)
+        old_prog = self._program
+
+        def interior(g):
+            return tuple(
+                slice(g.origin[dn], g.origin[dn] + gsz[dn])
+                if kind == "domain" else slice(None)
+                for dn, kind in g.axes)
+
+        new_state = {}
+        for name, ring in self._state.items():
+            og, ng = old_prog.geoms[name], new_prog.geoms[name]
+            oidx, nidx = interior(og), interior(ng)
+            new_state[name] = [
+                jnp.zeros(tuple(ng.shape), dtype=new_prog.dtype)
+                .at[nidx].set(jnp.asarray(a)[oidx]) for a in ring]
+        self._program = new_prog
+        self._plan_kwargs = new_kwargs
+        self._state = new_state
+        self._state_on_device = True
+        self._jit_cache.clear()
+
     def _get_pallas_chunk(self, K: int):
         """Compiled fused-Pallas chunk for K steps with the current block
         settings (cached per (K, block) — the auto-tuner varies both)."""
