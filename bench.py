@@ -166,7 +166,10 @@ def _run_suite_rows():
     same isolation pattern as ``_probe_platform``. Never fatal."""
     if os.environ.get("YT_BENCH_SUITE", "1") != "1":
         return
-    budget = float(os.environ.get("YT_SUITE_BUDGET", "900"))
+    try:
+        budget = float(os.environ.get("YT_SUITE_BUDGET", "900"))
+    except ValueError:
+        budget = 900.0   # never fatal: the contract line must still print
     suite = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "tools", "bench_suite.py")
     try:

@@ -105,3 +105,31 @@ def test_tile_planner_respects_fold_hints():
     blocks = plan_blocks(prog, fuse_steps=1)
     assert blocks["x"] in (4, 8, 16, 32)   # grown only by doubling
     assert set(blocks) == {"x", "y"}
+
+
+def test_element_apis_use_declared_order_with_misc_reorder(env):
+    """Arrays are stored misc-first physically, but the yk_var element
+    and slice APIs take indices/buffers in DECLARED dim order (reference
+    yk_var_api.hpp contract). Regression: interleaved misc dims
+    (A[t,x,a,y,b,c]) once indexed the physical array in declared order,
+    corrupting or rejecting valid accesses."""
+    import numpy as np
+    from yask_tpu import yk_factory
+    ctx = yk_factory().new_solution(env, stencil="test_misc_2d")
+    ctx.apply_command_line_options("-g 16")
+    ctx.prepare_solution()
+    v = ctx.get_var("A")
+    idx = [0, 5, 1, 6, 2, 3]   # t, x, a, y, b, c (declared order)
+    v.set_element(3.5, idx)
+    assert v.get_element(idx) == 3.5
+    v.add_to_element(1.0, idx)
+    assert v.get_element(idx) == 4.5
+    # slice round-trip in declared order across a misc axis
+    first = [0, 2, 0, 3, 1, 2]
+    last = [0, 4, 1, 5, 1, 3]
+    buf = v.get_elements_in_slice(first, last)
+    assert buf.shape == (3, 2, 3, 1, 2)   # declared (x, a, y, b, c)
+    buf2 = np.arange(buf.size, dtype=buf.dtype).reshape(buf.shape)
+    v.set_elements_in_slice(buf2, first, last)
+    out = v.get_elements_in_slice(first, last)
+    assert np.array_equal(out, buf2)

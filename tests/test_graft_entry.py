@@ -16,7 +16,9 @@ def test_entry_jits():
     out = jax.jit(fn)(*args)
     assert set(out.keys()) == {"pressure", "vel"}
     leaf = out["pressure"][-1]
-    assert leaf.shape[-1] == 128 + 16  # padded minor dim
+    # minor (lane) dim: interior+halos rounded to a 128-multiple so HBM
+    # physical layout == logical extent (Mosaic DMA alignment policy)
+    assert leaf.shape[-1] % 128 == 0 and leaf.shape[-1] >= 128 + 16
 
 
 @pytest.mark.parametrize("n", [1, 2, 4, 8])

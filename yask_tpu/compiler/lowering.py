@@ -197,6 +197,16 @@ class NumpyOps(ArrayOps):
 # ---------------------------------------------------------------------------
 
 
+def tpu_tile_dims(dtype) -> Tuple[int, int]:
+    """(sublane, lane) tile extents of the last two physical axes for
+    ``dtype`` (8×128 for f32, 16×128 for bf16). THE single definition —
+    VarGeom's allocation alignment and the pallas DMA slab planner must
+    agree or slab windows stop matching allocations."""
+    import numpy as np
+    esize = np.dtype(dtype).itemsize
+    return max(1, (8 * 4) // max(1, esize)), 128
+
+
 class VarGeom:
     """Array geometry for one var: axis order, pads, step allocation —
     the lowered analog of the reference's per-var halo/pad/alloc geometry
@@ -204,7 +214,8 @@ class VarGeom:
 
     def __init__(self, var, ana: SolutionAnalysis, sizes: IdxTuple,
                  extra_pad: Dict[str, Tuple[int, int]],
-                 pad_multiple: Optional[Dict[str, int]] = None):
+                 pad_multiple: Optional[Dict[str, int]] = None,
+                 dtype="float32"):
         self.var = var
         self.name = var.get_name()
         self.has_step = var.step_dim() is not None
@@ -212,12 +223,23 @@ class VarGeom:
         self.is_written = var.is_written
         self.is_scratch = var.is_scratch()
 
-        # Axes in declared order, step dim removed (step → list position).
+        # Physical axis order: misc axes FIRST, then domain axes in
+        # declared order, step dim removed (step → list position). TPU
+        # tiled HBM layouts constrain the last two physical axes
+        # (sublane×lane tiles), so domain dims must own them: small misc
+        # extents on the lane dim would force 128× over-padding, and the
+        # pallas DMA slab rules (see ops/pallas_stencil.py) only hold for
+        # domain windows.
         self.axes: List[Tuple[str, str]] = []  # (dim name, kind)
+        doms: List[Tuple[str, str]] = []
         for d in var.get_dims():
             if d.type == IndexType.STEP:
                 continue
-            self.axes.append((d.name, d.type.value))
+            if d.type.value == "misc":
+                self.axes.append((d.name, d.type.value))
+            else:
+                doms.append((d.name, d.type.value))
+        self.axes += doms
 
         self.domain_dims = [n for n, k in self.axes if k == "domain"]
         self.misc_lo: Dict[str, int] = {}
@@ -225,8 +247,25 @@ class VarGeom:
         self.origin: Dict[str, int] = {}   # pad_left per domain dim
         self.pads: Dict[str, Tuple[int, int]] = {}
 
+        # TPU tiling of the last two physical axes: lane tile is 128 for
+        # every dtype, sublane tile scales with element width (8 for f32,
+        # 16 for bf16). Mosaic DMA windows on tiled memrefs must have
+        # tile-aligned sizes and offsets (probed on v5e), so allocations
+        # keep lane totals 128-divisible, sublane origins/totals
+        # 8-divisible, and sublane right pads carry slack for slab
+        # rounding. Applied in every mode so one geometry serves all six
+        # execution paths.
+        sub_t, lane_t = tpu_tile_dims(dtype)
+        nax = len(self.axes)
+        lane_ax = nax - 1
+        sub_ax = nax - 2
+
+        def _lcm(a: int, b: int) -> int:
+            import math as _m
+            return a * b // _m.gcd(a, b)
+
         wh = ana.scratch_write_halo.get(self.name, {})
-        for n, k in self.axes:
+        for ai, (n, k) in enumerate(self.axes):
             if k == "domain":
                 hl, hr = var.halo.get(n, (0, 0))
                 el, er = extra_pad.get(n, (0, 0))
@@ -236,6 +275,12 @@ class VarGeom:
                 # (sharded mode needs whole-array divisibility; the analog
                 # of the reference rounding allocs to vector multiples).
                 mult = (pad_multiple or {}).get(n, 1)
+                if ai == lane_ax:
+                    mult = _lcm(max(mult, 1), lane_t)
+                elif ai == sub_ax:
+                    pl += (-pl) % sub_t          # aligned origin
+                    pr += 2 * sub_t              # slab-rounding slack
+                    mult = _lcm(max(mult, 1), sub_t)
                 if mult > 1:
                     pr += (-(sizes[n] + pl + pr)) % mult
                 self.pads[n] = (pl, pr)
@@ -244,7 +289,18 @@ class VarGeom:
             else:  # misc
                 lo, hi = var.misc_range.get(n, (0, 0))
                 self.misc_lo[n] = lo
-                self.shape.append(hi - lo + 1)
+                ext = hi - lo + 1
+                # misc axes in the tiled (last-two) positions only occur
+                # on vars WITH domain dims (a single-domain-dim var keeps
+                # misc at its sublane) — those are DMA'd whole, so the
+                # extent must be tile-aligned. Vars with no domain dims
+                # ride SMEM on the pallas path and stay unpadded.
+                if self.domain_dims:
+                    if ai == lane_ax:
+                        ext += (-ext) % lane_t
+                    elif ai == sub_ax:
+                        ext += (-ext) % sub_t
+                self.shape.append(ext)
 
     @property
     def num_slots(self) -> int:
@@ -300,7 +356,8 @@ class StepProgram:
         self.geoms: Dict[str, VarGeom] = {}
         for v in self.soln.get_vars():
             self.geoms[v.get_name()] = VarGeom(v, self.ana, sizes, extra_pad,
-                                               pad_multiple)
+                                               pad_multiple,
+                                               dtype=self.dtype)
 
         # Stage metadata for halo exchange / fused-tile margin accounting
         # (the dirty-width analog of the reference's per-var dirty flags,

@@ -69,11 +69,25 @@ def pallas_applicable(csol) -> Tuple[bool, str]:
     ana = csol.ana
     if len(ana.domain_dims) < 2:
         return False, "needs >= 2 domain dims"
+    minor = ana.domain_dims[-1]
     for v in csol.soln.get_vars():
         if v.is_written:
             if v.domain_dim_names() != ana.domain_dims:
                 return False, (f"written var '{v.get_name()}' must span "
                                "all domain dims")
+        else:
+            # Mosaic DMA windows constrain the lane (last physical) axis
+            # to 128-aligned full-extent fetches; a read-only var whose
+            # lane axis is a *lead* dim would need pid-dependent lane
+            # offsets, which TC vector loads cannot do (probed on v5e).
+            dd = v.domain_dim_names()
+            if dd and dd[-1] != minor:
+                return False, (f"read-only var '{v.get_name()}' lacks the "
+                               f"minor dim '{minor}' as its last domain "
+                               "dim (Mosaic lane-DMA alignment)")
+            if dd and dd != [d for d in ana.domain_dims if d in dd]:
+                return False, (f"var '{v.get_name()}' declares domain dims "
+                               "out of solution order")
 
     # misc indices used as VALUES have no tile lowering — reject at
     # prepare time with the fallback hint, not at first-run trace time
@@ -113,9 +127,11 @@ class _TileEval:
     """
 
     def __init__(self, jnp, program, minor: str,
-                 minor_origin: Dict[str, int]):
+                 minor_origin: Dict[str, int],
+                 resid: Optional[Dict[Tuple[str, str], int]] = None):
         self.jnp = jnp
         self.program = program
+        self.resid = resid or {}   # (var, lead dim) -> static tile shift
         self.dims = program.ana.domain_dims
         self.minor = minor
         self.step_dir = program.ana.step_dir
@@ -136,13 +152,16 @@ class _TileEval:
         distributed mode)."""
         di = self.dims.index(d)
         lo, hi = self.region[di]
-        ar = self.jnp.arange(lo, hi, dtype=self.jnp.int32)
+        shape = [1] * len(self.dims)
+        shape[di] = hi - lo
+        # broadcasted_iota, not 1-D arange+reshape: Mosaic TC crashes on
+        # non-lane-axis 1-D iota (probed on TPU v5e)
+        from jax import lax
+        ar = lax.broadcasted_iota(self.jnp.int32, tuple(shape), di) + lo
         base = self.gidx_base.get(d)
         if base is not None:
             ar = ar + base
-        shape = [1] * len(self.dims)
-        shape[di] = hi - lo
-        return ar.reshape(shape)
+        return ar
 
     def read(self, p: VarPoint, tiles, computed):
         name = p.var_name()
@@ -186,8 +205,12 @@ class _TileEval:
                 base = self.minor_origin[name]
                 idxs.append(slice(base + lo + o, base + hi + o))
             else:
-                idxs.append(slice(lo + o, hi + o))
-        out = arr[tuple(idxs)]
+                rs = self.resid.get((name, dn), 0)
+                idxs.append(slice(rs + lo + o, rs + hi + o))
+        if not g.axes:
+            out = arr[0]   # 0-dim var rides SMEM as shape (1,)
+        else:
+            out = arr[tuple(idxs)]
 
         var_dd = g.domain_dims
         if var_dd != self.dims:
@@ -267,12 +290,15 @@ class _TileEval:
 
 
 def default_vmem_budget(platform: str) -> int:
-    """Device-derived Pallas VMEM budget: ~16 MiB/core on real TPU (the
-    hardware guide's figure; overridable via ``-vmem_mb``), a loose
-    100 MiB under CPU interpret where VMEM is emulated and the budget
-    only shapes planning. Single definition for the runtime context,
-    harness tools, and bench."""
-    return 16 * 2 ** 20 if platform == "tpu" else 100 * 2 ** 20
+    """Device-derived Pallas VMEM *tile* budget (overridable via
+    ``-vmem_mb``). Probed on v5e: ≥120 MiB VMEM is usable once the
+    kernel raises Mosaic's 16 MiB default scoped limit via
+    ``vmem_limit_bytes``. The tile model budgets 64 MiB so live SSA
+    values (≈ a second copy of the tiles) still fit under the raised
+    limit. Under CPU interpret VMEM is emulated and the budget only
+    shapes planning. Single definition for the runtime context, harness
+    tools, and bench."""
+    return 64 * 2 ** 20 if platform == "tpu" else 100 * 2 ** 20
 
 
 def build_pallas_chunk(program, fuse_steps: int = 1,
@@ -299,6 +325,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     """
     import jax
     import jax.numpy as jnp
+    from jax import lax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -347,64 +374,171 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                     "with wf_steps set to the desired fusion depth")
 
     # default block: from the tile planner (fold hints → VREG mapping)
+    explicit_block = block is not None
     if block is None:
         from yask_tpu.ops.tile_planner import plan_blocks
         block = plan_blocks(program, fuse_steps=K, vmem_budget=vmem_budget)
     else:
         block = {d: min(b, sizes[d]) for d, b in zip(lead, block)}
+
+    # ---- Mosaic DMA slab geometry ---------------------------------------
+    # HBM memrefs carry a tiled (sublane×lane) layout; DMA windows must
+    # have tile-aligned sizes AND offsets on the last two physical axes
+    # (probed on TPU v5e). The lane axis of every DMA-able var is the
+    # solution minor (pallas_applicable) and rides WHOLE — VarGeom pads
+    # its total to a 128-multiple. Each var's sublane axis gets an
+    # 8-aligned window: the static part of the slab start is rounded
+    # down, the residual becomes a static in-tile shift, and the slab
+    # size is rounded up (VarGeom's sublane slack guarantees room).
+    from yask_tpu.compiler.lowering import tpu_tile_dims
+    sub_t, _lane_t = tpu_tile_dims(program.dtype)
+
+    def _sub_dim(g):
+        """The var's sublane (2nd-last physical) axis, when it is a lead
+        domain dim (the constrained window case)."""
+        if len(g.axes) >= 2:
+            dn, kind = g.axes[-2]
+            if kind == "domain" and dn != minor:
+                return dn
+        return None
+
+    non_scratch_geoms = [g for g in program.geoms.values()
+                         if not g.is_scratch]
+
+    def _slab_geom(g, d, b):
+        """(base, resid, slab_size) of dim-d windows for var g at block
+        size b."""
+        s = g.origin[d] - hK[d]
+        if _sub_dim(g) == d:
+            base = (s // sub_t) * sub_t
+            r = s - base
+            sz = -(-(b + 2 * hK[d] + r) // sub_t) * sub_t
+        else:
+            base, r, sz = s, 0, b + 2 * hK[d]
+        return base, r, sz
+
+    def _overshoot_ok(d, b):
+        """Ceil-coverage grids let the right-edge window run into the
+        right pad; every var's allocation must contain it."""
+        gcount = -(-sizes[d] // b)
+        for g in non_scratch_geoms:
+            if d not in g.domain_dims:
+                continue
+            if g.origin[d] - hK[d] < 0:
+                return False
+            base, _r, sz = _slab_geom(g, d, b)
+            if (gcount - 1) * b + base + sz > g.shape[g.axis_of(d)]:
+                return False
+        return True
+
+    def _fit_block(d, b):
+        sub = any(_sub_dim(g) == d for g in non_scratch_geoms)
+        step = sub_t if sub else 1
+        b = max(step, min(b, sizes[d]))
+        if sub:
+            b = max(step, (b // step) * step)
+        while b > step and not _overshoot_ok(d, b):
+            b -= step
+        if not _overshoot_ok(d, b):
+            raise YaskException(
+                f"no feasible pallas block in dim '{d}': pads too small "
+                "for DMA slab rounding; re-prepare with larger wf_steps "
+                "pads or different block sizes")
+        return b
+
     for d in lead:
-        if sizes[d] % block[d] != 0:
-            # shrink to a divisor
-            b = block[d]
-            while sizes[d] % b != 0:
-                b -= 1
-            block[d] = b
+        block[d] = _fit_block(d, block[d])
 
     var_order = [n for n in sorted(program.geoms)
                  if not program.geoms[n].is_scratch]
     written = [n for n in var_order if program.geoms[n].is_written]
     scratch_vars = [n for n in sorted(program.geoms)
                     if program.geoms[n].is_scratch]
+    # vars with no domain dims (scalars, misc-only parameter tables) ride
+    # SMEM and are read by static scalar indexing — no DMA, no VMEM tile
+    smem_vars = {n for n in var_order
+                 if not program.geoms[n].domain_dims}
+    dma_vars = [n for n in var_order if n not in smem_vars]
 
-    # tile geometry per var (its own axes): leading dims it has are sized
-    # block+2hK; the minor dim (if present) is its full padded extent;
-    # misc axes ride whole
+    base_off: Dict[Tuple[str, str], int] = {}
+    resid: Dict[Tuple[str, str], int] = {}
+    slab: Dict[Tuple[str, str], int] = {}
+
+    def _plan_slabs():
+        base_off.clear()
+        resid.clear()
+        slab.clear()
+        for n, g in program.geoms.items():
+            for d in g.domain_dims:
+                if d == minor:
+                    continue
+                if g.is_scratch:
+                    # scratch tiles never touch HBM: unconstrained
+                    base_off[n, d], resid[n, d] = 0, 0
+                    slab[n, d] = block[d] + 2 * hK[d]
+                else:
+                    base_off[n, d], resid[n, d], slab[n, d] = \
+                        _slab_geom(g, d, block[d])
+
+    _plan_slabs()
+
+    # tile geometry per var (its own axes): lead dims are DMA slabs, the
+    # minor (lane) dim and misc axes ride their whole padded extents
     def tile_shape(name):
         g = program.geoms[name]
         shp = []
         for i, (dn, kind) in enumerate(g.axes):
-            if kind == "misc":
+            if kind == "misc" or dn == minor:
                 shp.append(g.shape[i])
-            elif dn == minor:
-                pl_, pr_ = g.pads[minor]
-                shp.append(sizes[minor] + pl_ + pr_)
             else:
-                shp.append(block[dn] + 2 * hK[dn])
+                shp.append(slab[name, dn])
         return tuple(shp) if shp else (1,)  # 0-dim vars ride as (1,)
 
     dtype = program.dtype
     esize = jnp.dtype(dtype).itemsize
-    in_tile_bytes = 0
     slots: Dict[str, int] = {}
     for n in var_order:
-        g = program.geoms[n]
-        nslots = len(program_state_slots(program, n))
-        slots[n] = nslots
-        in_tile_bytes += nslots * int(
-            math.prod(tile_shape(n))) * esize
-    # workspace for sub-step results (rough: one extra tile per written
-    # var) and the in-tile scratch values
-    work_bytes = sum(int(math.prod(tile_shape(n))) * esize
+        slots[n] = len(program_state_slots(program, n))
+
+    def _tile_bytes():
+        in_b = sum(slots[n] * int(math.prod(tile_shape(n))) * esize
+                   for n in var_order if n not in smem_vars)
+        # workspace for sub-step results (rough: one extra tile per
+        # written var) and the in-tile scratch values
+        work_b = sum(int(math.prod(tile_shape(n))) * esize
                      for n in written)
-    work_bytes += sum(int(math.prod(tile_shape(n))) * esize
+        work_b += sum(int(math.prod(tile_shape(n))) * esize
                       for n in scratch_vars)
+        return in_b, work_b
+
+    in_tile_bytes, work_bytes = _tile_bytes()
+    # planner-chosen blocks auto-shrink until the tile model fits (its
+    # model can undercount misc slots / alignment rounding); explicitly
+    # requested blocks fail fast instead — the auto-tuner relies on the
+    # raise to mark infeasible candidates
+    while in_tile_bytes + work_bytes > vmem_budget and not explicit_block:
+        shrinkable = [d for d in lead
+                      if block[d] > (sub_t if any(
+                          _sub_dim(g) == d for g in non_scratch_geoms)
+                          else 1)]
+        if not shrinkable:
+            break
+        d = max(shrinkable, key=lambda dd: block[dd])
+        nb = _fit_block(d, max(1, block[d] // 2))
+        if nb >= block[d]:
+            break
+        block[d] = nb
+        _plan_slabs()
+        in_tile_bytes, work_bytes = _tile_bytes()
     tile_bytes = in_tile_bytes + work_bytes
     if tile_bytes > vmem_budget:
         raise YaskException(
             f"pallas tile needs {tile_bytes/2**20:.1f} MiB VMEM "
             f"(budget {vmem_budget/2**20:.0f}); shrink block or fuse_steps")
 
-    grid = tuple(sizes[d] // block[d] for d in lead)
+    # ceil coverage: edge windows overshoot into the (validated) right
+    # pads; overshoot cells read zero ghosts and mask to zero writes
+    grid = tuple(-(-sizes[d] // block[d]) for d in lead)
     total_steps = int(math.prod(grid)) if grid else 1
 
     # Double-buffer the input-tile DMAs across grid steps: while step i
@@ -427,7 +561,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     minor_origin = {n: (g.pads[minor][0]
                         if minor in g.domain_dims else 0)
                     for n, g in program.geoms.items()}
-    ev = _TileEval(jnp, program, minor, minor_origin)
+    ev = _TileEval(jnp, program, minor, minor_origin, resid)
 
     dirn = ana.step_dir
 
@@ -438,16 +572,29 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
 
     n_inputs = sum(slots[n] for n in var_order) + nscalars
 
+    in_base: Dict[str, int] = {}   # var -> first input-ref index
+    _ii = 0
+    for _n in var_order:
+        in_base[_n] = _ii
+        _ii += slots[_n]
+    si_base: Dict[str, int] = {}   # DMA var -> first scratch-tile index
+    _si = 0
+    for _n in dma_vars:
+        si_base[_n] = _si
+        _si += slots[_n]
+
     def kernel(*refs):
         # refs: t0 (SMEM), [offsets (SMEM)], inputs (ANY/HBM) ...,
-        #       outputs (VMEM blocks), scratch tiles ..., sem
+        #       outputs (ANY/HBM, padded shapes) ..., scratch tiles ...,
+        #       input-DMA sem, output-DMA sem
         t0_ref = refs[0]
         off_ref = refs[1] if distributed else None
         ins = refs[nscalars:n_inputs]
         nout = sum(min(K, slots[n]) for n in written)
         outs = refs[n_inputs:n_inputs + nout]
-        scratch = refs[n_inputs + nout:-1]
-        sem = refs[-1]
+        scratch = refs[n_inputs + nout:-2]
+        sem = refs[-2]
+        out_sem = refs[-1]
 
         pid = [pl.program_id(i) for i in range(len(lead))]
 
@@ -459,21 +606,23 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             ``coords`` into buffer ``buf`` (reconstructed identically to
             start and to wait)."""
             out = []
-            si = 0
-            for n in var_order:
+            for n in dma_vars:
                 g = program.geoms[n]
                 for s in range(slots[n]):
-                    src = ins[si]
+                    si = si_base[n] + s
+                    src = ins[in_base[n] + s]
                     idxs = []
                     for dn, kind in g.axes:
                         if kind == "misc" or dn == minor:
-                            idxs.append(slice(None))  # full extent
+                            idxs.append(slice(None))  # full (lane) extent
                         else:
                             di = lead.index(dn)
+                            # sublane-aligned window; the sub-tile
+                            # residual is a static shift the kernel
+                            # applies at read/write time
                             start = (coords[di] * block[dn]
-                                     + g.origin[dn] - hK[dn])
-                            idxs.append(
-                                pl.ds(start, block[dn] + 2 * hK[dn]))
+                                     + base_off[n, dn])
+                            idxs.append(pl.ds(start, slab[n, dn]))
                     if use_pipe:
                         dst = scratch[si].at[buf]
                         s_at = sem.at[buf, si]
@@ -482,7 +631,6 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                         s_at = sem.at[si]
                     out.append(pltpu.make_async_copy(
                         src.at[tuple(idxs)] if idxs else src, dst, s_at))
-                    si += 1
             return out
 
         if use_pipe:
@@ -522,14 +670,14 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         def buf_ref(si):
             return scratch[si].at[cur] if use_pipe else scratch[si]
 
-        # tiles as values
+        # tiles as values; SMEM vars stay as refs (scalar static reads)
         tiles: Dict[str, List] = {}
-        si = 0
         for n in var_order:
-            tiles[n] = []
-            for s in range(slots[n]):
-                tiles[n].append(buf_ref(si)[...])
-                si += 1
+            if n in smem_vars:
+                tiles[n] = [ins[in_base[n] + s] for s in range(slots[n])]
+            else:
+                tiles[n] = [buf_ref(si_base[n] + s)[...]
+                            for s in range(slots[n])]
 
         # 2) K fused sub-steps; within each, every stage consumes its read
         #    radius of tile margin (trapezoid shrink) and writes a FULL
@@ -550,26 +698,40 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                                       mo + region[-1][1]))
                 else:
                     lo, hi = region[dims.index(dn)]
-                    idxs.append(slice(lo, hi))
+                    rs = resid.get((name, dn), 0)
+                    idxs.append(slice(rs + lo, rs + hi))
             return tuple(idxs)
 
         def tile_update(base, idxs, val):
-            # dynamic_update_slice, NOT .at[].set: a full-tile static
-            # .at-set lowers to scatter whose empty i32 index array is a
-            # captured constant pallas_call rejects. Integer (misc) axes
-            # become size-1 update axes.
+            # Mosaic TC implements neither dynamic_update_slice nor
+            # scatter (probed on TPU v5e), so embed the statically-
+            # bounded region by lax.pad to tile shape + iota-mask select
+            # — pure vector ops. Integer (misc) axes become size-1
+            # update axes.
             from jax import lax
-            starts = []
+            bounds = []
             shape = []
             for s in idxs:
                 if isinstance(s, slice):
-                    starts.append(s.start)
+                    bounds.append((s.start, s.stop))
                     shape.append(s.stop - s.start)
                 else:
-                    starts.append(s)
+                    bounds.append((s, s + 1))
                     shape.append(1)
-            return lax.dynamic_update_slice(
-                base, val.reshape(tuple(shape)), tuple(starts))
+            val = val.reshape(tuple(shape))
+            pads = [(lo, base.shape[i] - hi, 0)
+                    for i, (lo, hi) in enumerate(bounds)]
+            padded = lax.pad(val, jnp.array(0, base.dtype), pads)
+            mask = None
+            for i, (lo, hi) in enumerate(bounds):
+                if lo == 0 and hi == base.shape[i]:
+                    continue
+                ax = lax.broadcasted_iota(jnp.int32, base.shape, i)
+                m = (ax >= lo) & (ax < hi)
+                mask = m if mask is None else mask & m
+            if mask is None:
+                return padded
+            return jnp.where(mask, padded, base)
 
         ev.gidx_base = {d: pid[lead.index(d)] * block[d] - hK[d]
                         for d in lead}
@@ -600,17 +762,19 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 mask = None
                 for di, d in enumerate(lead):
                     lo, hi = region[di]
-                    gidx = (jnp.arange(lo, hi)
-                            + pid[di] * block[d] - hK[d])
+                    shape = [1] * len(dims)
+                    shape[di] = hi - lo
+                    # broadcasted_iota: Mosaic TC crashes on non-lane
+                    # 1-D iota (probed on TPU v5e)
+                    gidx = (lax.broadcasted_iota(
+                                jnp.int32, tuple(shape), di)
+                            + lo + pid[di] * block[d] - hK[d])
                     if distributed:
                         gidx = gidx + off_ref[di]
                         bound = gdom[d]
                     else:
                         bound = sizes[d]
                     m = (gidx >= 0) & (gidx < bound)
-                    shape = [1] * len(dims)
-                    shape[di] = hi - lo
-                    m = m.reshape(shape)
                     mask = m if mask is None else mask & m
 
                 memo: Dict = {}
@@ -688,83 +852,92 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         # 3) write back the slots the K sub-steps actually produced (the
         #    newest min(K, alloc)); untouched older slots merely shifted
         #    and are rebuilt host-side from the existing padded inputs.
+        #    Outputs are PADDED arrays written by manual DMA: BlockSpec
+        #    windows cannot express the pad-origin offset, and manual
+        #    windows keep sublane offsets 8-aligned. Lane rows ride whole
+        #    so lane pads inherit the tile's zeros. The produced value is
+        #    first staged into the var's (already consumed) input scratch
+        #    tile, because DMA sources must be refs.
         #    NOTE: outputs are deliberately NOT aliased onto evicted ring
         #    slots — every tile DMA fetches halo margins from every slot,
         #    so an in-place interior write by one grid step would corrupt
         #    a later step's margin reads on real (aliasing) hardware.
+        out_copies = []
         oi = 0
         for name in written:
             g = program.geoms[name]
             ring = tiles[name]
             nback = min(K, slots[name])
             for s in range(nback):
-                src = ring[len(ring) - nback + s]
-                idxs = []
+                src_val = ring[len(ring) - nback + s]
+                sref = buf_ref(si_base[name] + s)
+                sref[...] = src_val
+                src_idxs = []
+                dst_idxs = []
                 for dn, kind in g.axes:
-                    if kind == "misc":
-                        idxs.append(slice(None))
-                    elif dn == minor:
-                        mlo = g.pads[minor][0]
-                        idxs.append(slice(mlo, mlo + sizes[minor]))
+                    if kind == "misc" or dn == minor:
+                        src_idxs.append(slice(None))
+                        dst_idxs.append(slice(None))
                     else:
-                        idxs.append(slice(hK[dn], hK[dn] + block[dn]))
-                outs[oi][...] = src[tuple(idxs)]
+                        di = lead.index(dn)
+                        src_idxs.append(pl.ds(hK[dn] + resid[name, dn],
+                                              block[dn]))
+                        dst_idxs.append(pl.ds(g.origin[dn]
+                                              + pid[di] * block[dn],
+                                              block[dn]))
+                cp = pltpu.make_async_copy(
+                    sref.at[tuple(src_idxs)],
+                    outs[oi].at[tuple(dst_idxs)],
+                    out_sem.at[oi])
+                cp.start()
+                out_copies.append(cp)
                 oi += 1
+        # all output DMAs must land before the next grid step re-fills
+        # the staging scratch tiles
+        for cp in out_copies:
+            cp.wait()
 
     # ---- pallas_call assembly -------------------------------------------
 
-    def out_geometry(name):
-        """(full shape, block shape, index_map) over the var's own axes:
-        misc axes ride whole (index 0), lead axes follow the grid."""
-        g = program.geoms[name]
-        full, blk = [], []
-        kinds = []
-        for i, (dn, kind) in enumerate(g.axes):
-            if kind == "misc":
-                full.append(g.shape[i])
-                blk.append(g.shape[i])
-                kinds.append(None)
-            elif dn == minor:
-                full.append(sizes[minor])
-                blk.append(sizes[minor])
-                kinds.append(None)
-            else:
-                full.append(sizes[dn])
-                blk.append(block[dn])
-                kinds.append(lead.index(dn))
-
-        def index_map(*pid, _kinds=tuple(kinds)):
-            return tuple(0 if k is None else pid[k] for k in _kinds)
-        return tuple(full), tuple(blk), index_map
-
+    # outputs are full padded arrays written by in-kernel manual DMA
     out_shapes = []
     out_specs = []
     for name in written:
-        full, blk, imap = out_geometry(name)
+        g = program.geoms[name]
         for _ in range(min(K, slots[name])):
-            out_shapes.append(jax.ShapeDtypeStruct(full, dtype))
-            out_specs.append(pl.BlockSpec(blk, imap))
+            out_shapes.append(jax.ShapeDtypeStruct(tuple(g.shape), dtype))
+            out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+    nout_total = len(out_shapes)
 
-    # leading scalars (step index, shard offsets) ride SMEM; arrays HBM
-    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] * nscalars \
-        + [pl.BlockSpec(memory_space=pl.ANY)] * (n_inputs - nscalars)
-    scratch_shapes = []
+    # leading scalars (step index, shard offsets) and domain-dim-less
+    # vars ride SMEM; DMA-able arrays stay in HBM (ANY)
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] * nscalars
     for n in var_order:
+        space = pltpu.SMEM if n in smem_vars else pl.ANY
+        in_specs += [pl.BlockSpec(memory_space=space)] * slots[n]
+    scratch_shapes = []
+    for n in dma_vars:
         for _ in range(slots[n]):
             shp = tile_shape(n)
             if use_pipe:
                 shp = (2,) + shp
             scratch_shapes.append(pltpu.VMEM(shp, dtype))
-    n_arrays = n_inputs - nscalars
+    n_arrays = sum(slots[n] for n in dma_vars)
     scratch_shapes.append(pltpu.SemaphoreType.DMA(
         (2, n_arrays) if use_pipe else (n_arrays,)))
+    scratch_shapes.append(pltpu.SemaphoreType.DMA((max(nout_total, 1),)))
 
     kwargs = {}
-    if use_pipe and not interpret:
-        # sequential grid: the linear-index prefetch requires it (no
-        # megacore partitioning of grid dims)
+    if not interpret:
+        # sequential grid always: staging the outputs reuses the input
+        # scratch tiles (racy under megacore partitioning), and the
+        # linear-index DMA prefetch additionally requires it. The VMEM
+        # limit is raised above Mosaic's 16 MiB default scope (v5e takes
+        # ≥120 MiB, probed): tiles budget vmem_budget, live SSA values
+        # roughly double it.
         kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",) * len(grid))
+            dimension_semantics=("arbitrary",) * len(grid),
+            vmem_limit_bytes=int(min(128 * 2 ** 20, 2 * vmem_budget)))
 
     call = pl.pallas_call(
         kernel,
@@ -789,12 +962,31 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         oi = 0
         for name in written:
             g = program.geoms[name]
-            pads = [g.pads[dn] if kind == "domain" else (0, 0)
-                    for dn, kind in g.axes]
             nback = min(K, slots[name])
             news = []
             for s in range(nback):
-                news.append(jnp.pad(outs[oi], pads))
+                a = outs[oi]
+                # outputs come back already padded (no re-pad copy); only
+                # the lead-dim pad bands the grid windows never touch
+                # need zeroing to keep the ghost-zero invariant (lane
+                # pads ride whole and inherit tile zeros; in-domain
+                # windows mask to zero outside the global problem)
+                for dn, kind in g.axes:
+                    if kind != "domain" or dn == minor:
+                        continue
+                    ax = g.axis_of(dn)
+                    o = g.origin[dn]
+                    gcount = -(-sizes[dn] // block[dn])
+                    hiw = o + gcount * block[dn]
+                    if o > 0:
+                        idx = [slice(None)] * a.ndim
+                        idx[ax] = slice(0, o)
+                        a = a.at[tuple(idx)].set(0)
+                    if hiw < a.shape[ax]:
+                        idx = [slice(None)] * a.ndim
+                        idx[ax] = slice(hiw, a.shape[ax])
+                        a = a.at[tuple(idx)].set(0)
+                news.append(a)
                 oi += 1
             # ring after K steps = surviving (already padded) input slots
             # shifted down, plus the newly produced ones

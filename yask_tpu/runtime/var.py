@@ -150,8 +150,8 @@ class yk_var:
                 f"var '{self._name}' needs {len(dims)} indices, "
                 f"got {len(indices)}")
         t = None
-        rest = []
         g = self._geom()
+        by_dim = {}
         for d, i in zip(dims, indices):
             if d.type.value == "step":
                 t = int(i)
@@ -167,7 +167,10 @@ class yk_var:
                     f"index {d.name}={i} of var '{self._name}' outside "
                     f"the allocation (padded extent {size}, left pad "
                     f"{g.pads.get(d.name, (0, 0))[0] if d.type.value == 'domain' else 0})")
-            rest.append(idx)
+            by_dim[d.name] = idx
+        # arrays are stored in PHYSICAL axis order (g.axes: misc first),
+        # which may differ from the declared order of the index list
+        rest = [by_dim[n] for n, _k in g.axes]
         return t, rest
 
     # -- element access (yk_var_api.hpp:700-951) ---------------------------
@@ -205,23 +208,46 @@ class yk_var:
         idx = tuple(slice(a, b + 1) for a, b in zip(rf, rl))
         return tf, idx
 
+    def _declared_perm(self):
+        """Permutation mapping physical (g.axes, misc-first) axis order
+        to the var's declared dim order — the buffer layout the
+        reference's slice APIs promise."""
+        g = self._geom()
+        phys = [n for n, _k in g.axes]
+        decl = [d.name for d in self._var().get_dims()
+                if d.type.value != "step"]
+        return [phys.index(n) for n in decl]
+
     def get_elements_in_slice(self, first_indices: Sequence[int],
                               last_indices: Sequence[int]) -> np.ndarray:
-        """Return a numpy copy of the box [first, last] (inclusive), the
-        buffer-protocol surface the reference exposes via SWIG pybuffer."""
+        """Return a numpy copy of the box [first, last] (inclusive) in
+        DECLARED dim order, the buffer-protocol surface the reference
+        exposes via SWIG pybuffer (arrays are stored misc-first
+        physically)."""
         t, idx = self._slice_idx(first_indices, last_indices)
         arr = np.asarray(self._ring()[self._slot_for_step(t)])
-        return np.array(arr[idx])
+        out = np.array(arr[idx])
+        perm = self._declared_perm()
+        if perm != list(range(out.ndim)):
+            out = out.transpose(perm)
+        return out
 
     def set_elements_in_slice(self, buf, first_indices: Sequence[int],
                               last_indices: Sequence[int]) -> int:
         t, idx = self._slice_idx(first_indices, last_indices)
         slot = self._slot_for_step(t)
         data = np.asarray(buf)
+        perm = self._declared_perm()
 
         def upd(a):
             out = np.array(a)
-            out[idx] = data.reshape(out[idx].shape)
+            tgt = out[idx]
+            # buffer arrives in DECLARED order; store physically
+            decl_shape = tuple(tgt.shape[p] for p in perm)
+            d = data.reshape(decl_shape)
+            if perm != list(range(tgt.ndim)):
+                d = d.transpose(np.argsort(perm))
+            out[idx] = d
             return out
         self._ctx._update_state_array(self._name, slot, upd)
         self._dirty = True

@@ -3,9 +3,10 @@
 Counterpart of the reference's AWP family (``src/stencils/AwpStencil.cpp:
 627-876``): staggered velocity–stress seismic propagation with
 
-* Cerjan sponge damping via 1-D per-dim factors (the reference supports a
-  3-D sponge var or 1-D factors, ``AwpStencil.cpp:34-100`` — the 1-D form
-  is used here),
+* Cerjan sponge damping via a 3-D sponge var (the reference supports a
+  3-D sponge var or 1-D factors, ``AwpStencil.cpp:34-100`` — the 3-D form
+  is the TPU-native layout: separable tapers fold into it at init, and a
+  full-dim coefficient rides lane-aligned DMA slabs),
 * free-surface boundary equations at the top of the domain expressed as
   ``IF_DOMAIN`` sub-domain conditions (the feature the reference's AWP
   exercises hardest),
@@ -61,12 +62,10 @@ class AwpBase(yc_solution_base):
         h = self.new_var("h", [])  # (dt/h) scalar-like var, no domain dims
 
         if self._ABC:
-            spx = self.new_var("sponge_x", [x])
-            spy = self.new_var("sponge_y", [y])
-            spz = self.new_var("sponge_z", [z])
+            sp = self.new_var("sponge", [x, y, z])
 
             def damp(e):
-                return e * spx(x) * spy(y) * spz(z)
+                return e * sp(x, y, z)
         else:
             def damp(e):
                 return e

@@ -212,8 +212,10 @@ class FSG2ElasticStencil(FSGElasticStencil):
 
 @register_solution
 class FSGElasticABCStencil(ElasticBase):
-    """'fsg_abc': FSG with separable absorbing-boundary damping factors
-    (1-D sponge vars per dim, like the AWP Cerjan factors)."""
+    """'fsg_abc': FSG with an absorbing-boundary damping coefficient (3-D
+    sponge var, the reference's ``AwpStencil.cpp:34-100`` alternative
+    form; separable per-dim tapers fold into it at init — the TPU-native
+    layout, since a full-dim coefficient rides lane-aligned DMA slabs)."""
 
     def __init__(self, name: str = "fsg_abc", radius: int = 2):
         super().__init__(name, radius)
@@ -233,12 +235,10 @@ class FSGElasticABCStencil(ElasticBase):
         C = {nm: self.new_var(f"c{nm}", [x, y, z])
              for nm in ("11", "12", "13", "22", "23", "33",
                         "44", "55", "66")}
-        spx = self.new_var("sponge_x", [x])
-        spy = self.new_var("sponge_y", [y])
-        spz = self.new_var("sponge_z", [z])
+        sp = self.new_var("sponge", [x, y, z])
 
         def damp(expr):
-            return expr * spx(x) * spy(y) * spz(z)
+            return expr * sp(x, y, z)
 
         for c in "xyz":
             i = ax[c]

@@ -57,10 +57,14 @@ class Iso3dfdStencil(Iso3dfdBase):
 
 @register_solution
 class Iso3dfdSpongeStencil(Iso3dfdBase):
-    """'iso3dfd_sponge': the same update multiplied by separable per-dim
-    absorbing-layer coefficients (the reference's sponge variant,
-    ``Iso3dfdStencil.cpp:249``; sponge arrays are 1-D per dim like the AWP
-    Cerjan factors, ``AwpStencil.cpp:34-100``)."""
+    """'iso3dfd_sponge': the same update multiplied by an absorbing-layer
+    coefficient (the reference's sponge variant,
+    ``Iso3dfdStencil.cpp:249``). The reference supports either 1-D
+    per-dim factors or a full 3-D sponge var (``AwpStencil.cpp:34-100``);
+    the TPU-native layout is the 3-D form — separable 1-D profiles fold
+    into it at init time, and a full-dim coefficient rides the same
+    lane-aligned DMA slabs as the field vars instead of forcing a
+    pid-dependent lane gather that Mosaic cannot lower."""
 
     def __init__(self, name: str = "iso3dfd_sponge", radius: int = 8):
         super().__init__(name, radius)
@@ -72,13 +76,11 @@ class Iso3dfdSpongeStencil(Iso3dfdBase):
         z = self.new_domain_index("z")
         p = self.new_var("pressure", [t, x, y, z])
         vel = self.new_var("vel", [x, y, z])
-        # Separable sponge factors (≤1 near boundaries, 1 inside).
-        sp_x = self.new_var("sponge_x", [x])
-        sp_y = self.new_var("sponge_y", [y])
-        sp_z = self.new_var("sponge_z", [z])
+        # Absorbing coefficient (≤1 near boundaries, 1 inside); holds the
+        # product of any separable per-dim tapers.
+        sp = self.new_var("sponge", [x, y, z])
 
         lap = self._laplacian(p, t, x, y, z)
         nxt = (2.0 * p(t, x, y, z) - p(t - 1, x, y, z)
                + vel(x, y, z) * lap)
-        p(t + 1, x, y, z).EQUALS(
-            nxt * sp_x(x) * sp_y(y) * sp_z(z))
+        p(t + 1, x, y, z).EQUALS(nxt * sp(x, y, z))
