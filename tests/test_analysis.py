@@ -169,3 +169,20 @@ def test_pointwise_ring_reduction():
     q(t3 + 1, x3, y3).EQUALS(q(t3, x3, y3) - q(t3 - 1, x3 - 1, y3))
     soln3.analyze()
     assert q.get_step_alloc_size() == 3
+
+
+def test_sincos_pairing_counted_once():
+    """sin(x)+cos(x) on one argument is charged a single transcendental
+    (reference PairingVisitor, ExprUtils.hpp:137); both lowering
+    backends materialize the pair in one visit. TTI's ti0-ti3 rotation
+    trig is the motivating case."""
+    from yask_tpu.compiler.solution_base import create_solution
+    from yask_tpu.compiler.expr import CounterVisitor
+    ana = create_solution("tti", radius=2).get_soln().compile().ana
+    assert ana.sincos_args, "tti computes paired sin/cos of theta/phi"
+    assert ana.counters.num_paired >= 2
+    unpaired = CounterVisitor()
+    for eq in ana.eqs:
+        eq.accept(unpaired)
+    assert ana.counters.num_ops == \
+        unpaired.num_ops - ana.counters.num_paired

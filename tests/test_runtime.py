@@ -222,6 +222,15 @@ def test_halo_time_measured(env):
     assert 0.0 <= frac < 1.0
     assert st.get_halo_secs() <= st.get_elapsed_secs()
     assert "halo-fraction" in st.format()
+    # second calibration point: one bare exchange round timed alone
+    # (collective cost without compute/overlap), VERDICT r2 item 8
+    assert st.get_halo_exchange_secs() > 0.0
+    assert "halo-exchange-round" in st.format()
+    # modeled HBM traffic: 3axis has 1 var x 2 slots read + 1 written
+    # (write-back) -> 12 B/pt at f32; the model reports pad-inclusive
+    # array bytes so it must be at least that
+    assert st.get_hbm_bytes_per_point() >= 12.0
+    assert "hbm-bytes-per-point" in st.format()
 
     # correctness is untouched by measurement
     oracle = yk_factory().new_solution(env, stencil="3axis", radius=1)

@@ -424,7 +424,31 @@ class SolutionAnalysis:
     # ------------------------------------------------------------------
 
     def _count(self) -> None:
-        c = CounterVisitor()
+        # sin/cos pairing (reference PairingVisitor, ExprUtils.hpp:137):
+        # sin(x) and cos(x) on structurally identical arguments are one
+        # paired evaluation — both lowering backends materialize the
+        # partner under its own CSE key in the same visit, and the op
+        # model charges the pair one transcendental (TTI's ti0–ti3 trig
+        # chains are the motivating case).
+        from yask_tpu.compiler.expr import ExprVisitor, FuncExpr
+
+        sin_args, cos_args = set(), set()
+
+        class _Trig(ExprVisitor):
+            def visit_func(self, node: FuncExpr):
+                if node.name == "sin":
+                    sin_args.add(node.args[0].skey())
+                elif node.name == "cos":
+                    cos_args.add(node.args[0].skey())
+                for a in node.args:
+                    a.accept(self)
+
+        tv = _Trig()
+        for eq in self.eqs:
+            eq.accept(tv)
+        self.sincos_args = sin_args & cos_args
+
+        c = CounterVisitor(sincos_args=self.sincos_args)
         for eq in self.eqs:
             eq.accept(c)
         self.counters = c

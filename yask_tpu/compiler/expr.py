@@ -804,12 +804,17 @@ class PointVisitor(ExprVisitor):
 
 class CounterVisitor(ExprVisitor):
     """Counts ops and points for FLOP/memory estimates (reference
-    ``CounterVisitor``, ``ExprUtils.hpp``)."""
+    ``CounterVisitor``, ``ExprUtils.hpp``). ``sincos_args`` holds the
+    structural keys of arguments whose sin AND cos both occur — the
+    pair is charged one transcendental (reference ``PairingVisitor``,
+    ``ExprUtils.hpp:137``; the cos half rides the sin visit)."""
 
-    def __init__(self):
+    def __init__(self, sincos_args=None):
         self.num_ops = 0
         self.num_reads = 0
         self.num_writes = 0
+        self.num_paired = 0
+        self._sincos = sincos_args or set()
 
     def visit_neg(self, node):
         self.num_ops += 1
@@ -836,7 +841,10 @@ class CounterVisitor(ExprVisitor):
         return self._visit_children(node)
 
     def visit_func(self, node):
-        self.num_ops += 1
+        if node.name == "cos" and node.args[0].skey() in self._sincos:
+            self.num_paired += 1   # charged on the paired sin visit
+        else:
+            self.num_ops += 1
         return self._visit_children(node)
 
     def visit_var_point(self, node):
@@ -855,3 +863,19 @@ def count_points(expr: Expr) -> List[VarPoint]:
     v = PointVisitor()
     expr.accept(v)
     return v.points
+
+
+def paired_func_eval(ops_func, e: "FuncExpr", args, memo, sincos_args):
+    """Evaluate a FuncExpr with sin/cos pairing: when the argument's sin
+    AND cos both occur in the solution (``SolutionAnalysis.sincos_args``,
+    reference ``PairingVisitor`` ``ExprUtils.hpp:137``), the partner is
+    materialized under its own CSE key in this same visit. THE single
+    definition — both the XLA and Pallas eval dispatchers call this, so
+    pairing semantics cannot drift between backends."""
+    r = ops_func(e.name, args)
+    if e.name in ("sin", "cos") and e.args[0].skey() in sincos_args:
+        partner = "cos" if e.name == "sin" else "sin"
+        pk = FuncExpr(partner, e.args).skey()
+        if pk not in memo:
+            memo[pk] = ops_func(partner, args)
+    return r

@@ -243,6 +243,7 @@ class VarGeom:
 
         self.domain_dims = [n for n, k in self.axes if k == "domain"]
         self.misc_lo: Dict[str, int] = {}
+        self.misc_ext: Dict[str, int] = {}   # DECLARED extent (pre-pad)
         self.shape: List[int] = []
         self.origin: Dict[str, int] = {}   # pad_left per domain dim
         self.pads: Dict[str, Tuple[int, int]] = {}
@@ -290,6 +291,7 @@ class VarGeom:
                 lo, hi = var.misc_range.get(n, (0, 0))
                 self.misc_lo[n] = lo
                 ext = hi - lo + 1
+                self.misc_ext[n] = ext
                 # misc axes in the tiled (last-two) positions only occur
                 # on vars WITH domain dims (a single-domain-dim var keeps
                 # misc at its sublane) — those are DMA'd whole, so the
@@ -400,6 +402,46 @@ class StepProgram:
                     arrs.append(self.ops.full(tuple(g.shape), 0.0, self.dtype))
             state[name] = arrs
         return state
+
+    def hbm_bytes_per_point(self, fuse_steps: int = 1,
+                            block: Optional[Dict[str, int]] = None
+                            ) -> Tuple[float, float]:
+        """Modeled HBM traffic per interior point per STEP as
+        ``(read_bytes, write_bytes)`` — the roofline yardstick next to
+        est-FLOPS (reference reads/writes-per-point report,
+        ``soln_apis.cpp:536-551``, recast at array granularity: a fused
+        XLA/Pallas step reads each live (var, ring-slot) array once and
+        writes each produced slot once; scratch vars never leave VMEM).
+        ``fuse_steps``/``block`` model the pallas K-group: reads pay the
+        tile-halo overlap factor and amortize over K."""
+        import numpy as np
+        esize = np.dtype(self.dtype).itemsize
+        dompts = 1
+        for d in self.ana.domain_dims:
+            dompts *= self.sizes[d]
+        K = max(1, fuse_steps)
+        rad = self.ana.fused_step_radius()
+        rd = 0.0
+        wr = 0.0
+        for name, g in self.geoms.items():
+            if g.is_scratch:
+                continue
+            cells = 1
+            for ext in g.shape:
+                cells *= ext
+            # fused-tile halo overlap on the lead dims actually blocked
+            ov = 1.0
+            if block:
+                num = den = 1.0
+                for d in self.ana.domain_dims[:-1]:
+                    if d in g.domain_dims and block.get(d):
+                        num *= block[d] + 2 * rad.get(d, 0) * K
+                        den *= block[d]
+                ov = num / max(den, 1.0)
+            rd += g.num_slots * cells * ov
+            if g.is_written:
+                wr += min(K, g.num_slots) * cells
+        return (esize * rd / (dompts * K), esize * wr / (dompts * K))
 
     # -- expression evaluation --------------------------------------------
 
@@ -540,7 +582,10 @@ class StepProgram:
         elif isinstance(e, ModExpr):
             r = ev(e.lhs) % ev(e.rhs)
         elif isinstance(e, FuncExpr):
-            r = ops.func(e.name, [ev(a) for a in e.args])
+            from yask_tpu.compiler.expr import paired_func_eval
+            r = paired_func_eval(ops.func, e, [ev(a) for a in e.args],
+                                 memo, getattr(self.ana, "sincos_args",
+                                               ()))
         elif isinstance(e, CompExpr):
             a, b = ev(e.lhs), ev(e.rhs)
             r = {"==": lambda: a == b, "!=": lambda: a != b,

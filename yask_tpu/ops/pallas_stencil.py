@@ -268,7 +268,10 @@ class _TileEval:
         elif isinstance(e, ModExpr):
             r = ev(e.lhs) % ev(e.rhs)
         elif isinstance(e, FuncExpr):
-            r = self.ops.func(e.name, [ev(a) for a in e.args])
+            from yask_tpu.compiler.expr import paired_func_eval
+            r = paired_func_eval(
+                self.ops.func, e, [ev(a) for a in e.args], memo,
+                getattr(self.program.ana, "sincos_args", ()))
         elif isinstance(e, CompExpr):
             a, b = ev(e.lhs), ev(e.rhs)
             r = {"==": lambda: a == b, "!=": lambda: a != b,

@@ -262,11 +262,18 @@ def _strip_global_interiors(ctx, gprog, names, mesh, specs_for, gsizes):
     return interior
 
 
-def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start):
-    """Measured halo fraction for one compiled variant: time the real
-    program against its no-exchange twin on copies of the interiors;
-    the shortfall is the per-call halo cost (reference halo-time
-    breakdown, ``context.hpp:318-328``). Cached under ``key``."""
+def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
+                         fn_xonly=None):
+    """Measured halo breakdown for one compiled variant (reference
+    per-phase halo timers, ``context.hpp:318-328``, recast for fused XLA
+    programs). Two calibration points, cached under ``key``:
+
+    * halo fraction — time the real program against its no-exchange
+      twin; the shortfall is the per-call halo cost INCLUDING overlap
+      effects (what the program actually pays);
+    * exchange round — time one full-state ghost exchange alone; the
+      bare collective cost. halo_cost − rounds×this is the overlap
+      shortfall (scheduling/serialization the collectives induce)."""
     import jax
     import jax.numpy as jnp
 
@@ -290,7 +297,80 @@ def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start):
     t_no = timed(fn_no)
     t_ex = timed(fn)
     ctx._halo_frac[key] = max(0.0, 1.0 - t_no / t_ex) if t_ex > 0 else 0.0
+    if fn_xonly is not None:
+        ctx._halo_xround[key] = timed(fn_xonly)
     return ctx._halo_frac[key]
+
+
+def _build_exchange_only(ctx, names, specs_for, slots, nr, lsizes,
+                         gsizes, width_scale: int = 1,
+                         written_only: bool = False, extra_pad=None):
+    """One ghost-exchange round compiled alone: pad, exchange at halo
+    widths × ``width_scale``, strip — no compute. The second halo
+    calibration point (bare collective cost). ``width_scale``/
+    ``written_only`` mirror the shard_pallas per-K-group exchange
+    (radius×K ghosts, only the freshly produced slots move); shard_map
+    uses the defaults (per-step halo-width refresh of every buffer)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec
+    shard_map = _shard_map_fn()
+    mesh = ctx._mesh
+    ana = ctx._csol.ana
+    in_specs = ({k: [specs_for(k)] * slots[k] for k in names},
+                PartitionSpec())
+    out_specs = {k: [specs_for(k)] * slots[k] for k in names}
+
+    def body(interior_state, t0):
+        offs = {d: lax.axis_index(d) * lsizes[d] if nr[d] > 1 else 0
+                for d in ana.domain_dims}
+        prog = ctx._csol.plan(lsizes, global_sizes=gsizes,
+                              rank_offset=offs,
+                              extra_pad=extra_pad or {})
+        out = {}
+        for k in names:
+            g = prog.geoms[k]
+            if written_only and not g.is_written:
+                out[k] = list(interior_state[k])
+                continue
+            pads, strip = [], []
+            for dn, kind in g.axes:
+                if kind == "domain":
+                    pads.append(g.pads[dn])
+                    strip.append(slice(g.origin[dn],
+                                       g.origin[dn] + lsizes[dn]))
+                else:
+                    pads.append((0, 0))
+                    strip.append(slice(None))
+            widths = {}
+            for d in g.domain_dims:
+                hl, hr = g.var.halo.get(d, (0, 0))
+                hl, hr = hl * width_scale, hr * width_scale
+                # pads bound what a round can move (shard_pallas plans
+                # radius×K pads; base-plan pads stay the base halo)
+                pl_, pr_ = g.pads[d]
+                hl, hr = min(hl, pl_), min(hr, pr_)
+                if (hl, hr) != (0, 0):
+                    widths[d] = (hl, hr)
+            moved = len(interior_state[k]) if not written_only \
+                else min(max(width_scale, 1), len(interior_state[k]))
+            ring = []
+            for si, a in enumerate(interior_state[k]):
+                p = jnp.pad(a, pads) if pads else a
+                if widths and si >= len(interior_state[k]) - moved:
+                    p = exchange_ghosts(p, g, widths, nr, lsizes)
+                ring.append(p[tuple(strip)] if pads else p)
+            out[k] = ring
+        return out
+
+    try:
+        mapped = shard_map(body, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+    except TypeError:  # older jax spells it check_rep
+        mapped = shard_map(body, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False)
+    return jax.jit(mapped, donate_argnums=0)
 
 
 def _repad_global(gprog, names, out):
@@ -478,11 +558,17 @@ def run_shard_map(ctx, start: int, n: int) -> None:
         t0cal = time.perf_counter()
         if key not in ctx._halo_frac:
             t0c = time.perf_counter()
-            fn_no = build(_no_exchange)
+            tj = jnp.asarray(start, dtype=jnp.int32)
+            fn_no = build(_no_exchange).lower(interior, tj).compile()
+            fn_x = _build_exchange_only(
+                ctx, names, specs_for, slots, nr, lsizes,
+                gsizes).lower(interior, tj).compile()
             ctx._compile_secs += time.perf_counter() - t0c
-            _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start)
-            del fn_no
+            _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
+                                 fn_xonly=fn_x)
+            del fn_no, fn_x
         frac = ctx._halo_frac[key]
+        ctx._halo_xround_last = ctx._halo_xround.get(key, 0.0)
         cal_secs = time.perf_counter() - t0cal
 
     t0c2 = time.perf_counter()
@@ -766,11 +852,23 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
             fn_no = jax.jit(build(_no_exchange), donate_argnums=0) \
                 .lower(interior,
                        jnp.asarray(start, dtype=jnp.int32)).compile()
+            slots_ = {k: ctx._program.geoms[k].num_slots for k in names}
+            rad = ctx._ana.fused_step_radius()
+            xpad = {d: (rad.get(d, 0) * (K - 1), rad.get(d, 0) * (K - 1))
+                    for d in dims}
+            fn_x = _build_exchange_only(
+                ctx, names, specs_for, slots_, nr,
+                opts.rank_domain_sizes, gsizes, width_scale=K,
+                written_only=True, extra_pad=xpad) \
+                .lower(interior,
+                       jnp.asarray(start, dtype=jnp.int32)).compile()
             ctx._compile_secs += time.perf_counter() - t0c
-            _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start)
-            del fn_no
+            _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
+                                 fn_xonly=fn_x)
+            del fn_no, fn_x
             t0r += time.perf_counter() - t0cal
         frac = ctx._halo_frac[key]
+        ctx._halo_xround_last = ctx._halo_xround.get(key, 0.0)
 
     ctx._resident = None   # interior buffers are donated next; any
     #                          failure before this point kept them valid

@@ -311,6 +311,8 @@ class StencilContext:
         self._cur_step = 0
         self._jit_cache.clear()
         self._halo_frac = {}
+        self._halo_xround = {}       # key -> secs per bare exchange round
+        self._halo_xround_last = 0.0
         for h in self._hooks["after_prepare"]:
             h(self)
 
@@ -823,13 +825,27 @@ class StencilContext:
     def get_stats(self) -> yk_stats:
         c = self._ana.counters
         npts = self._opts.global_domain_sizes.product()
+        rb_pp = wb_pp = 0.0
+        if self._program is not None:
+            mode = self._opts.mode
+            if mode in ("pallas", "shard_pallas"):
+                blk = {d: self._opts.block_sizes[d]
+                       for d in self._ana.domain_dims[:-1]
+                       if self._opts.block_sizes[d] > 0} or None
+                rb_pp, wb_pp = self._program.hbm_bytes_per_point(
+                    fuse_steps=max(1, self._opts.wf_steps), block=blk)
+            else:
+                rb_pp, wb_pp = self._program.hbm_bytes_per_point()
         st = yk_stats(
             npts=npts, nsteps=self._steps_done,
             nreads_pp=c.num_reads, nwrites_pp=c.num_writes,
             nfpops_pp=c.num_ops,
             elapsed=self._run_timer.get_elapsed_secs(),
             halo_secs=self._halo_timer.get_elapsed_secs(),
-            compile_secs=self._compile_secs)
+            compile_secs=self._compile_secs,
+            halo_exchange_secs=self._halo_xround_last,
+            read_bytes_pp=rb_pp, write_bytes_pp=wb_pp,
+            hbm_peak=self._env.get_hbm_peak_bytes_per_sec())
         return st
 
     def clear_stats(self) -> None:

@@ -54,6 +54,24 @@ class yk_env:
         plat = self._devices[0].platform
         return "tpu" if plat == "axon" else plat
 
+    def get_hbm_peak_bytes_per_sec(self) -> float:
+        """Per-chip HBM peak bandwidth for the roofline readout in
+        ``yk_stats`` (public per-generation figures; 0.0 when unknown —
+        e.g. the CPU mesh, where a roofline fraction is meaningless)."""
+        if not self._devices or self.get_platform() != "tpu":
+            return 0.0
+        kind = getattr(self._devices[0], "device_kind", "").lower()
+        table = (
+            ("v5 lite", 819e9), ("v5e", 819e9),
+            ("v5p", 2765e9), ("v5", 2765e9),
+            ("v6", 1640e9), ("trillium", 1640e9),
+            ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+        )
+        for tag, peak in table:
+            if tag in kind:
+                return peak
+        return 0.0
+
     # ---- collectives-over-ranks (single-controller no-ops, kept for API
     # parity with yk_env barriers/reductions) ------------------------------
 

@@ -13,7 +13,10 @@ from __future__ import annotations
 class yk_stats:
     def __init__(self, npts: int, nsteps: int, nreads_pp: int,
                  nwrites_pp: int, nfpops_pp: int, elapsed: float,
-                 halo_secs: float = 0.0, compile_secs: float = 0.0):
+                 halo_secs: float = 0.0, compile_secs: float = 0.0,
+                 halo_exchange_secs: float = 0.0,
+                 read_bytes_pp: float = 0.0, write_bytes_pp: float = 0.0,
+                 hbm_peak: float = 0.0):
         self._npts = npts
         self._nsteps = nsteps
         self._nreads_pp = nreads_pp
@@ -22,6 +25,10 @@ class yk_stats:
         self._elapsed = elapsed
         self._halo = halo_secs
         self._compile = compile_secs
+        self._halo_xround = halo_exchange_secs
+        self._rb_pp = read_bytes_pp
+        self._wb_pp = write_bytes_pp
+        self._hbm_peak = hbm_peak
 
     def get_num_elements(self) -> int:
         """Points in the global domain (per step)."""
@@ -60,6 +67,25 @@ class yk_stats:
         return (self.get_est_fp_ops_done() / self._elapsed
                 if self._elapsed > 0 else 0.0)
 
+    def get_halo_exchange_secs(self) -> float:
+        """Calibrated cost of ONE bare ghost-exchange round (collectives
+        only) — the second halo component next to get_halo_secs(), which
+        includes overlap effects."""
+        return self._halo_xround
+
+    def get_hbm_bytes_per_point(self) -> float:
+        """Modeled HBM traffic (read+write) per point per step."""
+        return self._rb_pp + self._wb_pp
+
+    def get_hbm_bytes_per_sec(self) -> float:
+        return self.get_pts_per_sec() * self.get_hbm_bytes_per_point()
+
+    def get_hbm_roofline_fraction(self) -> float:
+        """Achieved / peak HBM bandwidth (0 when the peak is unknown)."""
+        if self._hbm_peak <= 0:
+            return 0.0
+        return self.get_hbm_bytes_per_sec() / self._hbm_peak
+
     def format(self) -> str:
         gpts = self.get_pts_per_sec() / 1e9
         return (f"num-points-per-step: {self._npts}\n"
@@ -71,4 +97,11 @@ class yk_stats:
                 f"halo-time (sec): {self._halo:.6g}\n"
                 f"halo-fraction (%): "
                 f"{100.0 * self._halo / self._elapsed if self._elapsed else 0.0:.4g}\n"
+                f"halo-exchange-round (sec): {self._halo_xround:.6g}\n"
+                f"hbm-bytes-per-point (read+write): "
+                f"{self.get_hbm_bytes_per_point():.6g}\n"
+                f"achieved-HBM (GB/s): "
+                f"{self.get_hbm_bytes_per_sec() / 1e9:.6g}\n"
+                f"hbm-roofline-fraction (%): "
+                f"{100.0 * self.get_hbm_roofline_fraction():.4g}\n"
                 f"compile-time (sec): {self._compile:.6g}\n")

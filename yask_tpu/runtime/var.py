@@ -101,7 +101,7 @@ class yk_var:
 
     def get_last_misc_index(self, dim: str) -> int:
         g = self._geom()
-        return g.misc_lo[dim] + g.shape[g.axis_of(dim)] - 1
+        return g.misc_lo[dim] + g.misc_ext[dim] - 1
 
     # -- storage ----------------------------------------------------------
 
@@ -159,9 +159,12 @@ class yk_var:
             if d.type.value == "domain":
                 idx = (int(i) + g.origin[d.name]
                        - self._ctx._rank_offset.get(d.name, 0))
+                size = g.shape[g.axis_of(d.name)]
             else:
                 idx = int(i) - g.misc_lo[d.name]
-            size = g.shape[g.axis_of(d.name)]
+                # DECLARED misc range, not the tile-padded allocation:
+                # strict (check=1) indexing must reject pad rows
+                size = g.misc_ext[d.name]
             if not (0 <= idx < size):
                 raise YaskException(
                     f"index {d.name}={i} of var '{self._name}' outside "
