@@ -148,12 +148,7 @@ def try_pallas(fac, env, g, steps_per_trial, trials, candidates=(2, 4)):
             rate = measure(ctx, g, steps_per_trial, trials)
             if best is None or rate > best[0]:
                 # traffic model of the kernel actually benchmarked
-                blk = {d: ctx._opts.block_sizes[d]
-                       for d in ctx._ana.domain_dims[:-1]
-                       if ctx._opts.block_sizes[d] > 0} or None
-                bpp = sum(ctx._program.hbm_bytes_per_point(
-                    fuse_steps=K, block=blk))
-                best = (rate, K, bpp)
+                best = (rate, K, sum(ctx.hbm_model_bytes_pp()))
         except Exception:
             continue
     return best
@@ -241,7 +236,7 @@ def main():
             ctx = build(fac, env, g, "jit")
             rate = measure(ctx, g, steps_per_trial, trials)
             mode = "jit"
-            bytes_pp = sum(ctx._program.hbm_bytes_per_point())
+            bytes_pp = sum(ctx.hbm_model_bytes_pp())
             hbm_peak = env.get_hbm_peak_bytes_per_sec()
             del ctx
             # interpret-mode Pallas can never beat XLA off-TPU: only try

@@ -95,7 +95,9 @@ def test_pallas_multi_stage_ssg(env):
     ("iso3dfd_sponge", 2),   # partial-dim (1-D) coefficient vars
     ("awp", None),           # 4 stages, IF_DOMAIN conditions, 0-dim var
     ("test_partial_3d", None),  # partial vars w/o minor — expect fallback
-    ("test_step_cond_1d", None),  # IF_STEP — 1-D, expect fallback error
+    ("test_step_cond_1d", None),  # IF_STEP in a 1-D single-tile solution
+    ("test_scratch_1d", None),  # 1-D scratch chain, asymmetric halos
+    ("test_misc_value_2d", None),  # misc index as a value (per-eq memo)
     ("test_scratch_2d", None),  # 3-level scratch chain with reuse
     ("test_scratch_3d", None),  # diamond scratch deps
     ("swe2d", None),         # scratch-using physics (was a fallback)
@@ -124,10 +126,10 @@ def test_pallas_condition_and_partial_class(env, name, radius):
         ctx.run_solution(0, 3)
         return ctx
 
-    if name in ("test_step_cond_1d", "test_partial_3d"):
-        # test_partial_3d: read-only vars missing the minor dim have no
-        # Mosaic-lowerable DMA window (lane slices must be 128-aligned);
-        # the pallas mode must refuse with the named reason, not corrupt
+    if name == "test_partial_3d":
+        # read-only vars missing the minor dim have no Mosaic-lowerable
+        # DMA window (lane slices must be 128-aligned); the pallas mode
+        # must refuse with the named reason, not corrupt
         with pytest.raises(YaskException):
             mk("pallas")
         return
@@ -144,10 +146,13 @@ def test_pallas_applicability_rules():
     for name in ("ssg", "awp", "swe2d", "tti", "box", "test_stream_3d"):
         assert pallas_applicable(
             create_solution(name).get_soln().compile())[0], name
-    # 1-D solutions stay on the XLA path (nothing to tile)
+    # 1-D solutions tile as one full-lane block now
+    assert pallas_applicable(
+        create_solution("test_1d").get_soln().compile())[0]
+    # partial vars missing the minor dim have no Mosaic DMA window
     ok, why = pallas_applicable(
-        create_solution("test_1d").get_soln().compile())
-    assert not ok and "domain dims" in why
+        create_solution("test_partial_3d").get_soln().compile())
+    assert not ok and "minor" in why
 
 
 def test_pallas_rejects_fusion_beyond_planned_pad(env):
@@ -165,8 +170,9 @@ def test_pallas_rejects_fusion_beyond_planned_pad(env):
 
 
 def test_pallas_mode_rejects_inapplicable(env):
-    # 1-D solutions are not pallas-eligible (named reason in the error)
-    ctx = yk_factory().new_solution(env, stencil="test_scratch_1d")
+    # partial vars missing the minor dim are not pallas-eligible (named
+    # reason in the error; 1-D solutions became eligible in round 3)
+    ctx = yk_factory().new_solution(env, stencil="test_partial_3d")
     ctx.apply_command_line_options("-g 16")
     ctx.get_settings().mode = "pallas"
     with pytest.raises(YaskException):

@@ -865,6 +865,24 @@ def count_points(expr: Expr) -> List[VarPoint]:
     return v.points
 
 
+def uses_misc_index(*exprs) -> bool:
+    """True when any expression reads a MISC index as a value (its value
+    is the equation's pinned LHS misc index — constant per equation, so
+    eval memos must not be shared across equations)."""
+    class _MV(ExprVisitor):
+        found = False
+
+        def visit_index(self, node):
+            if node.type == IndexType.MISC:
+                self.found = True
+
+    v = _MV()
+    for e in exprs:
+        if e is not None:
+            e.accept(v)
+    return v.found
+
+
 def paired_func_eval(ops_func, e: "FuncExpr", args, memo, sincos_args):
     """Evaluate a FuncExpr with sin/cos pairing: when the argument's sin
     AND cos both occur in the solution (``SolutionAnalysis.sincos_args``,

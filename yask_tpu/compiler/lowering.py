@@ -557,8 +557,17 @@ class StepProgram:
                 shape[ax] = b - a
                 r = iarr.reshape(tuple(shape)) + off
             else:
-                raise YaskException(
-                    f"misc index '{e.name}' cannot be used as a value")
+                # A misc index used as a VALUE is the current equation's
+                # pinned LHS misc index — a per-equation constant
+                # (reference generated code inlines it). Never memoized:
+                # the same node appears in sibling equations with
+                # different LHS bindings.
+                mv = getattr(self, "_cur_misc", None) or {}
+                if e.name not in mv:
+                    raise YaskException(
+                        f"misc index '{e.name}' used as a value outside "
+                        "an equation that pins it on the LHS")
+                return mv[e.name]
         elif isinstance(e, FirstIndexExpr):
             r = self.global_first[e.dim.name]
         elif isinstance(e, LastIndexExpr):
@@ -637,6 +646,7 @@ class StepProgram:
         if part.is_scratch:
             # Evaluate over the (sub-)region expanded by the write-halo.
             for eq in part.eqs:
+                self._cur_misc = eq.lhs.misc_vals()
                 g = self.geoms[eq.lhs.var_name()]
                 wh = self.ana.scratch_write_halo.get(g.name, {})
                 region = {}
@@ -669,8 +679,16 @@ class StepProgram:
         # One memo across the whole part: no eq in a part reads a var the
         # part writes (parts have no internal deps), so cached reads stay
         # valid and duplicated subtrees across equations trace once.
+        # Exception: misc-index-as-value expressions evaluate differently
+        # per equation (LHS binding), so such parts memoize per equation.
+        from yask_tpu.compiler.expr import uses_misc_index
+        part_misc = any(uses_misc_index(eq.rhs, eq.cond, eq.step_cond)
+                        for eq in part.eqs)
         memo: Dict = {}
         for eq in part.eqs:
+            if part_misc:
+                memo = {}
+            self._cur_misc = eq.lhs.misc_vals()
             name = eq.lhs.var_name()
             g = self.geoms[name]
             ring = state[name]

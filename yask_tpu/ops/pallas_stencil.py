@@ -67,8 +67,10 @@ def pallas_applicable(csol) -> Tuple[bool, str]:
     partial-dim *written* vars (a tile owner for a var lacking grid dims
     is ambiguous) and 1-D solutions (nothing to tile)."""
     ana = csol.ana
-    if len(ana.domain_dims) < 2:
-        return False, "needs >= 2 domain dims"
+    if not ana.domain_dims:
+        return False, "needs >= 1 domain dim"
+    # 1-D solutions tile as a single full-lane block (empty grid): the
+    # whole padded line is one VMEM tile, K-fusion included
     minor = ana.domain_dims[-1]
     for v in csol.soln.get_vars():
         if v.is_written:
@@ -89,26 +91,6 @@ def pallas_applicable(csol) -> Tuple[bool, str]:
                 return False, (f"var '{v.get_name()}' declares domain dims "
                                "out of solution order")
 
-    # misc indices used as VALUES have no tile lowering — reject at
-    # prepare time with the fallback hint, not at first-run trace time
-    from yask_tpu.compiler.expr import ExprVisitor, IndexType
-
-    class _MiscValue(ExprVisitor):
-        found = False
-
-        def visit_index(self, node):
-            if node.type == IndexType.MISC:
-                self.found = True
-
-    mv = _MiscValue()
-    for eq in ana.eqs:
-        eq.rhs.accept(mv)
-        if eq.cond is not None:
-            eq.cond.accept(mv)
-        if eq.step_cond is not None:
-            eq.step_cond.accept(mv)
-    if mv.found:
-        return False, "uses a misc index as a value"
     return True, "ok"
 
 
@@ -144,6 +126,7 @@ class _TileEval:
         #                             tile position 0 (pid*block - hK)
         self.t = None               # step-index value (traced or None)
         self.scratch = {}           # scratch var -> full-tile value
+        self.misc_env = {}          # current equation's LHS misc binding
 
     def global_index(self, d: str):
         """Global coordinate array for dim d over the current region,
@@ -245,8 +228,15 @@ class _TileEval:
                 r = self.t
             elif e.type.value == "domain":
                 r = self.global_index(e.name)
-            else:  # pragma: no cover - excluded by pallas_applicable
-                raise YaskException("misc index as value on pallas path")
+            else:
+                # per-equation LHS-pinned constant; never memoized (the
+                # node recurs in sibling eqs with different bindings)
+                mv = self.misc_env or {}
+                if e.name not in mv:
+                    raise YaskException(
+                        f"misc index '{e.name}' used as a value outside "
+                        "an equation that pins it on the LHS")
+                return mv[e.name]
         elif isinstance(e, FirstIndexExpr):
             r = 0
         elif isinstance(e, LastIndexExpr):
@@ -335,6 +325,9 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     ana = program.ana
     dims = ana.domain_dims
     K = fuse_steps
+    from yask_tpu.compiler.expr import uses_misc_index
+    has_misc_value = any(
+        uses_misc_index(eq.rhs, eq.cond, eq.step_cond) for eq in ana.eqs)
     lead = dims[:-1]
     minor = dims[-1]
 
@@ -789,6 +782,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                         # already budgeted the margin for the chain) and
                         # persist as full-tile values for offset reads.
                         for eq in part.eqs:
+                            ev.misc_env = eq.lhs.misc_vals()
                             name = eq.lhs.var_name()
                             wh = ana.scratch_write_halo.get(name, {})
                             sregion = []
@@ -817,7 +811,15 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                         continue
 
                     ev.region = region
+                    # misc-as-value evaluates per LHS binding: such parts
+                    # memoize per equation (mirrors _eval_part's scoping)
+                    part_misc = has_misc_value and any(
+                        uses_misc_index(eq.rhs, eq.cond, eq.step_cond)
+                        for eq in part.eqs)
                     for eq in part.eqs:
+                        if part_misc:
+                            memo = {}
+                        ev.misc_env = eq.lhs.misc_vals()
                         name = eq.lhs.var_name()
                         lmisc = eq.lhs.misc_vals()
                         val = ev.eval(eq.rhs, tiles, computed, memo)

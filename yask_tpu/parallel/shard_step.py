@@ -304,7 +304,8 @@ def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
 
 def _build_exchange_only(ctx, names, specs_for, slots, nr, lsizes,
                          gsizes, width_scale: int = 1,
-                         written_only: bool = False, extra_pad=None):
+                         written_only: bool = False, extra_pad=None,
+                         uniform_widths=None):
     """One ghost-exchange round compiled alone: pad, exchange at halo
     widths × ``width_scale``, strip — no compute. The second halo
     calibration point (bare collective cost). ``width_scale``/
@@ -345,10 +346,15 @@ def _build_exchange_only(ctx, names, specs_for, slots, nr, lsizes,
                     strip.append(slice(None))
             widths = {}
             for d in g.domain_dims:
-                hl, hr = g.var.halo.get(d, (0, 0))
-                hl, hr = hl * width_scale, hr * width_scale
-                # pads bound what a round can move (shard_pallas plans
-                # radius×K pads; base-plan pads stay the base halo)
+                if uniform_widths is not None:
+                    # shard_pallas exchanges fused_step_radius×K slabs
+                    # uniformly (the single-definition invariant) — the
+                    # twin must move the same payload
+                    hl, hr = uniform_widths.get(d, (0, 0))
+                else:
+                    hl, hr = g.var.halo.get(d, (0, 0))
+                    hl, hr = hl * width_scale, hr * width_scale
+                # pads bound what a round can move
                 pl_, pr_ = g.pads[d]
                 hl, hr = min(hl, pl_), min(hr, pr_)
                 if (hl, hr) != (0, 0):
@@ -854,12 +860,14 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
                        jnp.asarray(start, dtype=jnp.int32)).compile()
             slots_ = {k: ctx._program.geoms[k].num_slots for k in names}
             rad = ctx._ana.fused_step_radius()
-            xpad = {d: (rad.get(d, 0) * (K - 1), rad.get(d, 0) * (K - 1))
+            xpad = {d: (rad.get(d, 0) * K, rad.get(d, 0) * K)
                     for d in dims}
+            uw = {d: (rad.get(d, 0) * K, rad.get(d, 0) * K)
+                  for d in dims}
             fn_x = _build_exchange_only(
                 ctx, names, specs_for, slots_, nr,
                 opts.rank_domain_sizes, gsizes, width_scale=K,
-                written_only=True, extra_pad=xpad) \
+                written_only=True, extra_pad=xpad, uniform_widths=uw) \
                 .lower(interior,
                        jnp.asarray(start, dtype=jnp.int32)).compile()
             ctx._compile_secs += time.perf_counter() - t0c

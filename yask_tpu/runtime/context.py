@@ -24,7 +24,7 @@ Execution modes (see ``KernelSettings.mode``):
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -822,20 +822,24 @@ class StencilContext:
     # stats (yk_stats)
     # ------------------------------------------------------------------
 
+    def hbm_model_bytes_pp(self) -> Tuple[float, float]:
+        """(read, write) HBM bytes per point per step of the CONFIGURED
+        execution path (mode/wf_steps/blocks resolved from settings) —
+        THE single resolution used by get_stats and bench.py."""
+        if self._program is None:
+            return (0.0, 0.0)
+        if self._opts.mode in ("pallas", "shard_pallas"):
+            blk = {d: self._opts.block_sizes[d]
+                   for d in self._ana.domain_dims[:-1]
+                   if self._opts.block_sizes[d] > 0} or None
+            return self._program.hbm_bytes_per_point(
+                fuse_steps=max(1, self._opts.wf_steps), block=blk)
+        return self._program.hbm_bytes_per_point()
+
     def get_stats(self) -> yk_stats:
         c = self._ana.counters
         npts = self._opts.global_domain_sizes.product()
-        rb_pp = wb_pp = 0.0
-        if self._program is not None:
-            mode = self._opts.mode
-            if mode in ("pallas", "shard_pallas"):
-                blk = {d: self._opts.block_sizes[d]
-                       for d in self._ana.domain_dims[:-1]
-                       if self._opts.block_sizes[d] > 0} or None
-                rb_pp, wb_pp = self._program.hbm_bytes_per_point(
-                    fuse_steps=max(1, self._opts.wf_steps), block=blk)
-            else:
-                rb_pp, wb_pp = self._program.hbm_bytes_per_point()
+        rb_pp, wb_pp = self.hbm_model_bytes_pp()
         st = yk_stats(
             npts=npts, nsteps=self._steps_done,
             nreads_pp=c.num_reads, nwrites_pp=c.num_writes,

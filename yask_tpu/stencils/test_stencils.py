@@ -171,6 +171,32 @@ class TestMisc2d(yc_solution_with_radius_base):
 
 
 @register_solution
+class TestMiscValue2d(yc_solution_with_radius_base):
+    """Misc index used as a VALUE (test_misc_value_2d): each equation's
+    RHS reads the misc index it pins on the LHS — the per-equation
+    constant the reference's generated code inlines. Exercises the
+    per-equation eval-memo scoping in every backend (a shared memo
+    would leak one equation's binding into its siblings)."""
+
+    def __init__(self):
+        super().__init__("test_misc_value_2d", radius=1)
+
+    def define(self):
+        t = self.new_step_index("t")
+        x = self.new_domain_index("x")
+        y = self.new_domain_index("y")
+        im = self.new_misc_index("i")
+        r = self.get_radius()
+        a = self.new_var("A", [t, x, y, im])
+        for i in range(3):
+            v = a(t, x, y, i) * 0.5 + im * 0.25
+            for k in range(1, r + 1):
+                v = v + (a(t, x + k, y, i) - a(t, x - k, y, i)) \
+                    * (im + 1.0)
+            a(t + 1, x, y, i).EQUALS(v)
+
+
+@register_solution
 class TestScratch1d(yc_solution_with_radius_base):
     """Scratch var read at far offsets from the write point (reference
     ``TestScratchStencil1``, ``TestStencils.cpp:626``: reads around
