@@ -41,7 +41,8 @@ def log(stage, **kv):
     print(json.dumps({"stage": stage, **kv}), flush=True)
 
 
-def build(fac, env, name, mode, g, radius, wf=1, block=None, tune=False):
+def build(fac, env, name, mode, g, radius, wf=1, block=None, tune=False,
+          tune_max=None):
     from yask_tpu.runtime.init_utils import init_solution_vars
     ctx = fac.new_solution(env, stencil=name, radius=radius)
     ctx.apply_command_line_options(f"-g {g} -wf_steps {wf}")
@@ -52,6 +53,8 @@ def build(fac, env, name, mode, g, radius, wf=1, block=None, tune=False):
         # shrink it (K-doubling candidates would otherwise all fail pad
         # validation and cache as inf).
         ctx.get_settings().do_auto_tune = True
+        if tune_max:
+            ctx.get_settings().tune_max_wf_steps = tune_max
     if block:
         for d, b in block.items():
             ctx.set_block_size(d, b)
@@ -112,7 +115,9 @@ def main(argv=None) -> int:
     else:
         log("validate", summary="all pallas cases match jit on device")
 
-    # 3) pipeline A/B (timing on real DMA engines)
+    # 3) pipeline + skew A/Bs (timing on real DMA engines).  Each stage
+    #    is isolated: a Mosaic failure in one A/B must not cost the rest
+    #    of the session (the relay window may be short).
     from yask_tpu.ops.pallas_stencil import build_pallas_chunk
     from yask_tpu.utils.idx_tuple import IdxTuple
     from yask_tpu.compiler.solution_base import create_solution
@@ -120,49 +125,74 @@ def main(argv=None) -> int:
     gi = min(g_bench, 256)
     prog = create_solution("iso3dfd", radius=8).get_soln().compile().plan(
         IdxTuple(x=gi, y=gi, z=gi),
-        extra_pad={"x": (16, 16), "y": (16, 16), "z": (0, 0)})
+        extra_pad={"x": (32, 32), "y": (32, 32), "z": (0, 0)})
     state = prog.alloc_state()
     interp = plat != "tpu"   # only under YT_TPU_SESSION_FORCE
     from yask_tpu.ops.pallas_stencil import default_vmem_budget
     budget = default_vmem_budget(plat)
-    for pipe in (False, True):
-        chunk, tb = build_pallas_chunk(prog, fuse_steps=2,
-                                       pipeline_dmas=pipe,
-                                       interpret=interp,
-                                       vmem_budget=budget)
-        fn = chunk if interp else jax.jit(chunk).lower(state, 0).compile()
-        st = fn(state, 0)
-        jax.block_until_ready(st)
-        t0 = time.perf_counter()
-        for _ in range(5):
-            st = fn(st, 0)
-        jax.block_until_ready(st)
-        dt = (time.perf_counter() - t0) / 5
-        log("pipeline_ab", pipelined=pipe, tile_mib=round(tb / 2**20, 2),
-            secs_per_chunk=round(dt, 5),
-            gpts=round(gi ** 3 * 2 / dt / 1e9, 2))
 
-    # 4) joint auto-tune at the bench size
+    def time_chunk(tag, **kw):
+        try:
+            chunk, tb = build_pallas_chunk(prog, interpret=interp,
+                                           vmem_budget=budget, **kw)
+            fn = chunk if interp else \
+                jax.jit(chunk).lower(state, 0).compile()
+            st = fn(state, 0)
+            jax.block_until_ready(st)
+            t0 = time.perf_counter()
+            for _ in range(5):
+                st = fn(st, 0)
+            jax.block_until_ready(st)
+            dt = (time.perf_counter() - t0) / 5
+            k = kw.get("fuse_steps", 1)
+            log(tag, **{k2: v for k2, v in kw.items()},
+                tile_mib=round(tb / 2**20, 2),
+                secs_per_chunk=round(dt, 5),
+                gpts=round(gi ** 3 * k / dt / 1e9, 2))
+        except Exception as e:  # noqa: BLE001
+            log(tag, error=str(e)[:300], **kw)
+
+    for pipe in (False, True):
+        time_chunk("pipeline_ab", fuse_steps=2, pipeline_dmas=pipe,
+                   skew=False)
+    # skew A/B: uniform shrink vs streaming skewed wavefront, growing K
+    for k in (2, 4):
+        for sk in (False, True):
+            time_chunk("skew_ab", fuse_steps=k, skew=sk)
+
+    # 4) joint auto-tune at the bench size.  tune_max_wf_steps stays
+    #    small: pads are planned for radius × the cap, so 16 would
+    #    inflate every state array (784^3 for 512^3 at r=8) and make
+    #    each candidate compile minutes long.
     from yask_tpu.runtime.auto_tuner import AutoTuner
-    ctx = build(fac, env, "iso3dfd", "pallas", g_bench, 8, wf=2, tune=True)
+    ctx = build(fac, env, "iso3dfd", "pallas", g_bench, 8, wf=2,
+                tune=True, tune_max=4)
     ctx.get_settings().auto_tune_trial_secs = 0.5
-    tuner = AutoTuner(ctx)
-    best_k = tuner.run_auto_tuner_now()
-    s = ctx.get_settings()
-    log("tune", wf_steps=best_k,
-        blocks={d: s.block_sizes[d] for d in ("x", "y")},
-        candidates=len(tuner.results))
+    try:
+        tuner = AutoTuner(ctx)
+        best_k = tuner.run_auto_tuner_now()
+        s = ctx.get_settings()
+        log("tune", wf_steps=best_k,
+            blocks={d: s.block_sizes[d] for d in ("x", "y")},
+            candidates=len(tuner.results))
+    except Exception as e:  # noqa: BLE001
+        log("tune", error=str(e)[:300])
 
     # 5) tuned bench
-    steps = 4 if quick else 20
-    ctx.run_solution(0, steps - 1)   # warm
-    ctx.clear_stats()
-    ctx.run_solution(steps, 2 * steps - 1)
-    st = ctx.get_stats()
-    rate = st.get_pts_per_sec() / 1e9
-    log("bench", metric=f"iso3dfd r=8 {g_bench}^3 fp32 tpu pallas-tuned",
-        value=round(rate, 3), unit="GPts/s",
-        vs_baseline=round(rate / 500.0, 4))
+    try:
+        steps = 4 if quick else 20
+        ctx.run_solution(0, steps - 1)   # warm
+        ctx.clear_stats()
+        ctx.run_solution(steps, 2 * steps - 1)
+        st = ctx.get_stats()
+        rate = st.get_pts_per_sec() / 1e9
+        log("bench",
+            metric=f"iso3dfd r=8 {g_bench}^3 fp32 tpu pallas-tuned",
+            value=round(rate, 3), unit="GPts/s",
+            vs_baseline=round(rate / 500.0, 4))
+    except Exception as e:  # noqa: BLE001
+        log("bench", error=str(e)[:300])
+        return 1
     return 0
 
 

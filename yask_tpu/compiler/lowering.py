@@ -404,7 +404,8 @@ class StepProgram:
         return state
 
     def hbm_bytes_per_point(self, fuse_steps: int = 1,
-                            block: Optional[Dict[str, int]] = None
+                            block: Optional[Dict[str, int]] = None,
+                            skew: bool = False
                             ) -> Tuple[float, float]:
         """Modeled HBM traffic per interior point per STEP as
         ``(read_bytes, write_bytes)`` — the roofline yardstick next to
@@ -413,7 +414,10 @@ class StepProgram:
         XLA/Pallas step reads each live (var, ring-slot) array once and
         writes each produced slot once; scratch vars never leave VMEM).
         ``fuse_steps``/``block`` model the pallas K-group: reads pay the
-        tile-halo overlap factor and amortize over K."""
+        tile-halo overlap factor and amortize over K.  ``skew`` models
+        the streaming skewed wavefront: the innermost blocked dim
+        fetches (K+1)·r of margin instead of 2·K·r (the inter-tile
+        strips ride the VMEM carry)."""
         import numpy as np
         esize = np.dtype(self.dtype).itemsize
         dompts = 1
@@ -421,6 +425,8 @@ class StepProgram:
             dompts *= self.sizes[d]
         K = max(1, fuse_steps)
         rad = self.ana.fused_step_radius()
+        lead = self.ana.domain_dims[:-1]
+        sdim = lead[-1] if lead else None
         rd = 0.0
         wr = 0.0
         for name, g in self.geoms.items():
@@ -433,9 +439,12 @@ class StepProgram:
             ov = 1.0
             if block:
                 num = den = 1.0
-                for d in self.ana.domain_dims[:-1]:
+                for d in lead:
                     if d in g.domain_dims and block.get(d):
-                        num *= block[d] + 2 * rad.get(d, 0) * K
+                        if skew and d == sdim:
+                            num *= block[d] + (K + 1) * rad.get(d, 0)
+                        else:
+                            num *= block[d] + 2 * rad.get(d, 0) * K
                         den *= block[d]
                 ov = num / max(den, 1.0)
             rd += g.num_slots * cells * ov

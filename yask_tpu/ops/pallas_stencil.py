@@ -282,6 +282,20 @@ class _TileEval:
 # ---------------------------------------------------------------------------
 
 
+def skew_eligible(program, fuse_steps: int) -> bool:
+    """Would :func:`build_pallas_chunk` auto-engage the skewed wavefront
+    for this (program, K)?  Shared by the build itself and the HBM
+    traffic model so bench/stats describe the tiling actually run."""
+    ana = program.ana
+    lead = ana.domain_dims[:-1]
+    if fuse_steps < 2 or not lead:
+        return False
+    from yask_tpu.compiler.lowering import tpu_tile_dims
+    sub_t, _ = tpu_tile_dims(program.dtype)
+    r = ana.fused_step_radius().get(lead[-1], 0)
+    return r > 0 and r % sub_t == 0
+
+
 def default_vmem_budget(platform: str) -> int:
     """Device-derived Pallas VMEM *tile* budget (overridable via
     ``-vmem_mb``). Probed on v5e: ≥120 MiB VMEM is usable once the
@@ -299,7 +313,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                        interpret: bool = False,
                        vmem_budget: int = 100 * 2 ** 20,
                        distributed: bool = False,
-                       pipeline_dmas: Optional[bool] = None):
+                       pipeline_dmas: Optional[bool] = None,
+                       skew: Optional[bool] = None):
     """Build ``chunk(state, t0) -> state`` advancing ``fuse_steps`` steps
     in one fused Pallas sweep.
 
@@ -315,6 +330,21 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     fused sub-steps while true physical boundaries stay zero. ``program``
     must then be the per-shard plan built with ``global_sizes`` (its
     ``global_last`` drives last_domain_index conditions).
+
+    ``skew`` selects the streaming skewed-wavefront tiling along the
+    innermost (sequential) grid dim: each fused sub-step's compute region
+    shifts left by the step radius instead of shrinking symmetrically,
+    and the inter-tile boundary strips each sub-step needs from its left
+    neighbor ride a persistent VMEM carry (double-buffered by grid
+    parity).  This removes BOTH the redundant margin recompute and the
+    2·r·K-wide halo DMA of the uniform shrink in that dim — the
+    TPU-native answer to the reference's two-phase trapezoid blocking
+    (``setup.cpp:863``, ``context.cpp:838``), whose phase coloring exists
+    to create *thread* parallelism a sequential Pallas grid does not
+    need.  ``None`` = auto: on for single-device K ≥ 2 when the geometry
+    is eligible.  Distributed chunks keep the uniform shrink: the skewed
+    left margin would need (2K−1)·r-wide exchanged ghosts, but
+    shard_pallas plans (and exchanges) radius×K.
     """
     import jax
     import jax.numpy as jnp
@@ -353,6 +383,42 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
 
     sizes = {d: program.sizes[d] for d in dims}
 
+    # Streaming skew rides the innermost grid dim (the one consecutive
+    # sequential grid steps advance by +1, so the VMEM carry written by
+    # step i is what step i+1 patches in).
+    sdim = lead[-1] if lead else None
+    ring_read_vars = set()
+    for sr_ in program.stage_reads:
+        ring_read_vars.update(sr_.keys())
+    from yask_tpu.compiler.lowering import tpu_tile_dims
+    sub_t, _lane_t = tpu_tile_dims(program.dtype)
+    # carry depth per var = its ring allocation (an upper bound on how
+    # many sub-steps back its levels are read).  The per-level write
+    # windows shift by r per sub-step, and the stream dim is the
+    # sublane (tiled) axis of every written var, so HBM window
+    # alignment currently restricts skew to sublane-multiple radii
+    # (r=8 fp32 — the iso3dfd order-16 flagship).
+    skew_ok = skew_eligible(program, K)
+    use_skew = skew
+    if use_skew is None:
+        use_skew = skew_ok and not distributed
+    elif use_skew and (not skew_ok or distributed):
+        raise YaskException(
+            f"skewed wavefront needs K >= 2, a single-device chunk "
+            f"(distributed ghosts are only radius×K wide), and a stream "
+            f"radius that is a multiple of the sublane tile ({sub_t}); "
+            f"got K={K}, distributed={distributed}, "
+            f"radius={rad.get(sdim, 0) if sdim else 0}")
+    R_s = rad.get(sdim, 0) if sdim else 0
+    # per-dim tile margins: uniform shrink = radius×K both sides; the
+    # skewed stream dim keeps K·r on the left (the write regions shift
+    # left by r per sub-step) but only r on the right
+    mL = {d: hK[d] for d in lead}
+    mR = {d: hK[d] for d in lead}
+    if use_skew:
+        mL[sdim] = K * R_s
+        mR[sdim] = R_s
+
     # Every var's leading-dim pads must cover the fused halo, or the DMA
     # start/end would clamp silently and corrupt results: the runtime
     # plans extra_pad = radius*K at prepare time, so a K larger than
@@ -363,13 +429,14 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             if d not in g.domain_dims:
                 continue  # partial-dim var lacks this axis
             pl_, pr_ = g.pads[d]
-            if pl_ < hK[d] or pr_ < hK[d]:
+            if pl_ < mL[d] or pr_ < mR[d]:
                 raise YaskException(
-                    f"pallas fuse_steps={K} needs pad >= {hK[d]} in dim "
+                    f"pallas fuse_steps={K} needs pad >= {mL[d]} in dim "
                     f"'{d}' but var '{n}' has ({pl_},{pr_}); re-prepare "
                     "with wf_steps set to the desired fusion depth")
 
     # default block: from the tile planner (fold hints → VREG mapping)
+    block_arg = tuple(block) if block is not None else None
     explicit_block = block is not None
     if block is None:
         from yask_tpu.ops.tile_planner import plan_blocks
@@ -386,9 +453,6 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # 8-aligned window: the static part of the slab start is rounded
     # down, the residual becomes a static in-tile shift, and the slab
     # size is rounded up (VarGeom's sublane slack guarantees room).
-    from yask_tpu.compiler.lowering import tpu_tile_dims
-    sub_t, _lane_t = tpu_tile_dims(program.dtype)
-
     def _sub_dim(g):
         """The var's sublane (2nd-last physical) axis, when it is a lead
         domain dim (the constrained window case)."""
@@ -401,26 +465,34 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     non_scratch_geoms = [g for g in program.geoms.values()
                          if not g.is_scratch]
 
+    def _gcount(d, b):
+        """Grid extent in dim d: ceil coverage; the skewed stream dim
+        needs (K−1)·r more tiles on the right because the final-level
+        write regions sit shifted left by (K−1)·r."""
+        span = sizes[d] + ((K - 1) * R_s if (use_skew and d == sdim)
+                           else 0)
+        return -(-span // b)
+
     def _slab_geom(g, d, b):
         """(base, resid, slab_size) of dim-d windows for var g at block
         size b."""
-        s = g.origin[d] - hK[d]
+        s = g.origin[d] - mL[d]
         if _sub_dim(g) == d:
             base = (s // sub_t) * sub_t
             r = s - base
-            sz = -(-(b + 2 * hK[d] + r) // sub_t) * sub_t
+            sz = -(-(b + mL[d] + mR[d] + r) // sub_t) * sub_t
         else:
-            base, r, sz = s, 0, b + 2 * hK[d]
+            base, r, sz = s, 0, b + mL[d] + mR[d]
         return base, r, sz
 
     def _overshoot_ok(d, b):
         """Ceil-coverage grids let the right-edge window run into the
         right pad; every var's allocation must contain it."""
-        gcount = -(-sizes[d] // b)
+        gcount = _gcount(d, b)
         for g in non_scratch_geoms:
             if d not in g.domain_dims:
                 continue
-            if g.origin[d] - hK[d] < 0:
+            if g.origin[d] - mL[d] < 0:
                 return False
             base, _r, sz = _slab_geom(g, d, b)
             if (gcount - 1) * b + base + sz > g.shape[g.axis_of(d)]:
@@ -471,7 +543,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 if g.is_scratch:
                     # scratch tiles never touch HBM: unconstrained
                     base_off[n, d], resid[n, d] = 0, 0
-                    slab[n, d] = block[d] + 2 * hK[d]
+                    slab[n, d] = block[d] + mL[d] + mR[d]
                 else:
                     base_off[n, d], resid[n, d], slab[n, d] = \
                         _slab_geom(g, d, block[d])
@@ -496,6 +568,20 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     for n in var_order:
         slots[n] = len(program_state_slots(program, n))
 
+    # skewed-wavefront carry: per ring-read written var, the (D+1)·r-wide
+    # boundary strips of levels 1..K−1 that the next tile patches in,
+    # double-buffered by grid parity (tile i writes p=i%2, i+1 reads it)
+    carry_vars = ([n for n in written if n in ring_read_vars]
+                  if use_skew else [])
+    carr_base = {n: i for i, n in enumerate(carry_vars)}
+
+    def carry_shape(name):
+        shp = list(tile_shape(name))
+        g = program.geoms[name]
+        ax = [i for i, (dn, _k) in enumerate(g.axes) if dn == sdim][0]
+        shp[ax] = (slots[name] + 1) * R_s
+        return (2, max(K - 1, 1)) + tuple(shp)
+
     def _tile_bytes():
         in_b = sum(slots[n] * int(math.prod(tile_shape(n))) * esize
                    for n in var_order if n not in smem_vars)
@@ -505,6 +591,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                      for n in written)
         work_b += sum(int(math.prod(tile_shape(n))) * esize
                       for n in scratch_vars)
+        work_b += sum(int(math.prod(carry_shape(n))) * esize
+                      for n in carry_vars)
         return in_b, work_b
 
     in_tile_bytes, work_bytes = _tile_bytes()
@@ -526,6 +614,30 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         block[d] = nb
         _plan_slabs()
         in_tile_bytes, work_bytes = _tile_bytes()
+    # Skew feasibility: the carry save-strips must come from the tile's
+    # own valid region (block[sdim] ≥ (D+1)·r, D = deepest carried
+    # ring), and the carry buffers must fit the budget alongside the
+    # tiles.  Auto-engaged skew falls back to the uniform tiling rather
+    # than failing a configuration that tiling still fits.
+    if use_skew:
+        d_max = max((slots[n] for n in carry_vars), default=0)
+        infeasible = (carry_vars
+                      and block[sdim] < (d_max + 1) * R_s) or \
+            (in_tile_bytes + work_bytes > vmem_budget)
+        if infeasible:
+            if skew:   # explicitly requested: surface the constraint
+                raise YaskException(
+                    f"skewed wavefront needs block[{sdim}] >= "
+                    f"{(d_max + 1) * R_s} (ring {d_max} × radius "
+                    f"{R_s}) and carry within the VMEM budget; got "
+                    f"block {block[sdim]}, "
+                    f"{(in_tile_bytes + work_bytes)/2**20:.1f} MiB")
+            return build_pallas_chunk(
+                program, fuse_steps=fuse_steps, block=block_arg,
+                interpret=interpret, vmem_budget=vmem_budget,
+                distributed=distributed, pipeline_dmas=pipeline_dmas,
+                skew=False)
+
     tile_bytes = in_tile_bytes + work_bytes
     if tile_bytes > vmem_budget:
         raise YaskException(
@@ -534,7 +646,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
 
     # ceil coverage: edge windows overshoot into the (validated) right
     # pads; overshoot cells read zero ghosts and mask to zero writes
-    grid = tuple(-(-sizes[d] // block[d]) for d in lead)
+    grid = tuple(_gcount(d, block[d]) for d in lead)
     total_steps = int(math.prod(grid)) if grid else 1
 
     # Double-buffer the input-tile DMAs across grid steps: while step i
@@ -588,7 +700,9 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         ins = refs[nscalars:n_inputs]
         nout = sum(min(K, slots[n]) for n in written)
         outs = refs[n_inputs:n_inputs + nout]
-        scratch = refs[n_inputs + nout:-2]
+        n_tiles = sum(slots[n] for n in dma_vars)
+        scratch = refs[n_inputs + nout:n_inputs + nout + n_tiles]
+        carr = refs[n_inputs + nout + n_tiles:-2]
         sem = refs[-2]
         out_sem = refs[-1]
 
@@ -729,23 +843,94 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 return padded
             return jnp.where(mask, padded, base)
 
-        ev.gidx_base = {d: pid[lead.index(d)] * block[d] - hK[d]
+        ev.gidx_base = {d: pid[lead.index(d)] * block[d] - mL[d]
                         for d in lead}
         if distributed:
             for di, d in enumerate(dims):
                 ev.gidx_base[d] = ev.gidx_base.get(d, 0) + off_ref[di]
+
+        # ---- skewed-wavefront carry helpers -------------------------
+        # Sub-step s writes W_s = [i·B − (s−1)·r, i·B + B − (s−1)·r) in
+        # the stream dim; reading level ℓ at sub-step s needs [W_s.lo −
+        # r, …) — below this tile's own computed span.  Those cells are
+        # the previous tile's freshly-computed right edge: it saved them
+        # into the parity carry, and this tile patches them in before
+        # each sub-step (width 2r for a level's first patch — its
+        # computed validity starts 2r right of the read edge — then r
+        # per later sub-step while it stays live; (D+1)·r total).
+        def _strip_idx(name, lo, width):
+            g = program.geoms[name]
+            shp = tile_shape(name)
+            idxs = []
+            for i, (dn, kind) in enumerate(g.axes):
+                if kind == "domain" and dn == sdim:
+                    rs_ = resid.get((name, dn), 0)
+                    idxs.append(slice(rs_ + lo, rs_ + lo + width))
+                else:
+                    idxs.append(slice(0, shp[i]))
+            return tuple(idxs)
+
+        def _carry_idx(name, lvl, off, width, par):
+            g = program.geoms[name]
+            idxs = [par, lvl - 1]
+            for dn, kind in g.axes:
+                if kind == "domain" and dn == sdim:
+                    idxs.append(slice(off, off + width))
+                else:
+                    idxs.append(slice(None))
+            return tuple(idxs)
+
+        if use_skew and carry_vars:
+            spid = pid[-1]
+            wpar0 = (spid % 2) == 0    # this tile writes carry buf 0
+
         for k in range(K):
             computed: Dict[str, object] = {}
             ev.scratch = {}   # scratch values are per-sub-step
             consumed = {d: rad[d] * k for d in lead}
             ev.t = t0_ref[0] + k * dirn
+
+            # patch the live ring levels' left strips from the previous
+            # tile's carry before computing sub-step k+1
+            if use_skew and carry_vars and k >= 1:
+                for n in carry_vars:
+                    Dn = slots[n]
+                    ring = tiles[n]
+                    for j in range(len(ring)):
+                        lvl = k - (len(ring) - 1 - j)
+                        if lvl < 1:
+                            continue
+                        width = (2 if lvl == k else 1) * R_s
+                        lo = (K - k - 1) * R_s
+                        coff = (lvl + Dn - k - 1) * R_s
+                        cref = carr[carr_base[n]]
+                        s0 = cref[_carry_idx(n, lvl, coff, width, 0)]
+                        s1 = cref[_carry_idx(n, lvl, coff, width, 1)]
+                        # reader parity = writer tile (spid−1)'s parity
+                        strip = jnp.where(wpar0, s1, s0)
+                        # row start: the left margin is out-of-domain
+                        # ghost (single-device skew only) — zero
+                        strip = jnp.where(spid > 0, strip,
+                                          jnp.zeros_like(strip))
+                        ring[j] = tile_update(
+                            ring[j], _strip_idx(n, lo, width), strip)
+
             for si_stage in range(nstages):
                 for d in lead:
                     consumed[d] += stage_r[si_stage][d]
                 region = []
                 for d in lead:
-                    region.append((consumed[d],
-                                   block[d] + 2 * hK[d] - consumed[d]))
+                    if use_skew and d == sdim:
+                        # skew: fixed-width region sliding left by r per
+                        # sub-step; stages still consume their margins
+                        c_stage = consumed[d] - rad[d] * k
+                        lo = mL[d] - (k + 1) * R_s + c_stage
+                        region.append((lo, lo + block[d]
+                                       + 2 * (R_s - c_stage)))
+                    else:
+                        region.append((consumed[d],
+                                       block[d] + mL[d] + mR[d]
+                                       - consumed[d]))
                 # minor: interior-relative (per-var pad origin applied at
                 # read/write time); pads stay zero
                 region.append((0, sizes[minor]))
@@ -764,7 +949,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                     # 1-D iota (probed on TPU v5e)
                     gidx = (lax.broadcasted_iota(
                                 jnp.int32, tuple(shape), di)
-                            + lo + pid[di] * block[d] - hK[d])
+                            + lo + pid[di] * block[d] - mL[d])
                     if distributed:
                         gidx = gidx + off_ref[di]
                         bound = gdom[d]
@@ -854,6 +1039,29 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 else:
                     tiles[name] = [newest]
 
+            # save this level's right-edge strip for the next tile
+            # (level k+1; levels 1..K−1 are ever patched)
+            if use_skew and carry_vars and k + 1 <= K - 1:
+                for n in carry_vars:
+                    Dn = slots[n]
+                    lo = block[sdim] + (K - (k + 1) - Dn) * R_s
+                    width = (Dn + 1) * R_s
+                    strip = tiles[n][-1][_strip_idx(n, lo, width)]
+                    cref = carr[carr_base[n]]
+
+                    def _store(cref=cref, n=n, k=k, width=width,
+                               strip=strip):
+                        @pl.when(wpar0)
+                        def _w0():
+                            cref[_carry_idx(n, k + 1, 0, width, 0)] = \
+                                strip
+
+                        @pl.when(jnp.logical_not(wpar0))
+                        def _w1():
+                            cref[_carry_idx(n, k + 1, 0, width, 1)] = \
+                                strip
+                    _store()
+
         # 3) write back the slots the K sub-steps actually produced (the
         #    newest min(K, alloc)); untouched older slots merely shifted
         #    and are rebuilt host-side from the existing padded inputs.
@@ -874,6 +1082,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             ring = tiles[name]
             nback = min(K, slots[name])
             for s in range(nback):
+                lvl = K - nback + s + 1   # time level this slot holds
                 src_val = ring[len(ring) - nback + s]
                 sref = buf_ref(si_base[name] + s)
                 sref[...] = src_val
@@ -883,9 +1092,22 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                     if kind == "misc" or dn == minor:
                         src_idxs.append(slice(None))
                         dst_idxs.append(slice(None))
+                    elif use_skew and dn == sdim:
+                        # level lvl's write region sits shifted left by
+                        # (lvl−1)·r; skew eligibility guarantees the
+                        # shift is sublane-aligned, so the HBM window
+                        # offset stays tile-aligned
+                        shift = (lvl - 1) * R_s
+                        src_idxs.append(pl.ds(
+                            mL[dn] - shift + resid[name, dn],
+                            block[dn]))
+                        dst_idxs.append(pl.ds(
+                            g.origin[dn] - shift
+                            + pid[lead.index(dn)] * block[dn],
+                            block[dn]))
                     else:
                         di = lead.index(dn)
-                        src_idxs.append(pl.ds(hK[dn] + resid[name, dn],
+                        src_idxs.append(pl.ds(mL[dn] + resid[name, dn],
                                               block[dn]))
                         dst_idxs.append(pl.ds(g.origin[dn]
                                               + pid[di] * block[dn],
@@ -927,6 +1149,9 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             if use_pipe:
                 shp = (2,) + shp
             scratch_shapes.append(pltpu.VMEM(shp, dtype))
+    # skewed-wavefront carry strips persist across the sequential grid
+    for n in carry_vars:
+        scratch_shapes.append(pltpu.VMEM(carry_shape(n), dtype))
     n_arrays = sum(slots[n] for n in dma_vars)
     scratch_shapes.append(pltpu.SemaphoreType.DMA(
         (2, n_arrays) if use_pipe else (n_arrays,)))
@@ -971,18 +1196,19 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             news = []
             for s in range(nback):
                 a = outs[oi]
-                # outputs come back already padded (no re-pad copy); only
-                # the lead-dim pad bands the grid windows never touch
-                # need zeroing to keep the ghost-zero invariant (lane
-                # pads ride whole and inherit tile zeros; in-domain
-                # windows mask to zero outside the global problem)
+                # outputs come back already padded (no re-pad copy); the
+                # lead-dim pad bands are re-zeroed to keep the
+                # ghost-zero invariant (lane pads ride whole and inherit
+                # tile zeros; window cells outside the global problem —
+                # ceil overshoot, skewed-level shift — were masked to
+                # zero in-kernel, so zeroing the whole out-of-interior
+                # band is equivalent and covers both tilings)
                 for dn, kind in g.axes:
                     if kind != "domain" or dn == minor:
                         continue
                     ax = g.axis_of(dn)
                     o = g.origin[dn]
-                    gcount = -(-sizes[dn] // block[dn])
-                    hiw = o + gcount * block[dn]
+                    hiw = o + sizes[dn]
                     if o > 0:
                         idx = [slice(None)] * a.ndim
                         idx[ax] = slice(0, o)

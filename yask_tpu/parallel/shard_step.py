@@ -862,12 +862,10 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
             rad = ctx._ana.fused_step_radius()
             xpad = {d: (rad.get(d, 0) * K, rad.get(d, 0) * K)
                     for d in dims}
-            uw = {d: (rad.get(d, 0) * K, rad.get(d, 0) * K)
-                  for d in dims}
             fn_x = _build_exchange_only(
                 ctx, names, specs_for, slots_, nr,
                 opts.rank_domain_sizes, gsizes, width_scale=K,
-                written_only=True, extra_pad=xpad, uniform_widths=uw) \
+                written_only=True, extra_pad=xpad, uniform_widths=xpad) \
                 .lower(interior,
                        jnp.asarray(start, dtype=jnp.int32)).compile()
             ctx._compile_secs += time.perf_counter() - t0c

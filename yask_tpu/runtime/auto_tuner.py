@@ -123,9 +123,36 @@ class AutoTuner:
             compiled = make_compiled()
         except YaskException:
             # infeasible candidate (tile over the VMEM budget, fusion
-            # beyond planned pads) — skip it; real compile errors raise
+            # beyond planned pads) — skip it
             self.results[key] = float("inf")
             return float("inf")
+        except Exception as e:  # noqa: BLE001
+            # Backend compile failures are also infeasibility signals:
+            # the in-build tile model cannot see Mosaic's register-
+            # allocator spill slots, so a candidate can pass the budget
+            # check yet exhaust VMEM at compile time (observed on v5e:
+            # "Ran out of memory in memory space vmem ... register
+            # allocator spill slots", surfaced as an INTERNAL remote-
+            # compile error).  Walking on is the reference tuner's
+            # stance too: a failed apply just scores worst
+            # (auto_tuner.cpp eval loop).  But a dead relay makes EVERY
+            # compile fail with backend errors — three consecutive
+            # failures re-raise so an outage stays loud instead of
+            # ending the walk "successfully" with all-inf results.
+            msg = f"{type(e).__name__}: {e}"
+            if ("RESOURCE_EXHAUSTED" in msg or "vmem" in msg.lower()
+                    or "Mosaic" in msg or "INTERNAL" in msg
+                    or "tpu_compile" in msg):
+                self._consec_fails = getattr(self, "_consec_fails", 0) + 1
+                if self._consec_fails >= 3:
+                    raise
+                self.ctx._env.trace_msg(
+                    f"auto-tuner: candidate {key} failed to compile "
+                    f"({msg[:160]}); marking infeasible")
+                self.results[key] = float("inf")
+                return float("inf")
+            raise
+        self._consec_fails = 0
         # warmup call (not timed — excludes dispatch jitter)
         call(compiled)
         calls = 0

@@ -616,13 +616,14 @@ class StencilContext:
         if any(bs[d] > 0 for d in self._ana.domain_dims[:-1]):
             blk = tuple(bs[d] if bs[d] > 0 else 8
                         for d in self._ana.domain_dims[:-1])
-        key = ("pallas", K, blk)
+        skw = None if self._opts.skew_wavefront else False
+        key = ("pallas", K, blk, skw)
         if key not in self._jit_cache:
             from yask_tpu.ops.pallas_stencil import build_pallas_chunk
             interp = self._env.get_platform() != "tpu"
             chunk, tile_bytes = build_pallas_chunk(
                 self._program, fuse_steps=K, block=blk, interpret=interp,
-                vmem_budget=self.vmem_budget())
+                vmem_budget=self.vmem_budget(), skew=skw)
             self._state_to_device()
             t0c = time.perf_counter()
             if interp:
@@ -832,8 +833,13 @@ class StencilContext:
             blk = {d: self._opts.block_sizes[d]
                    for d in self._ana.domain_dims[:-1]
                    if self._opts.block_sizes[d] > 0} or None
+            K = max(1, self._opts.wf_steps)
+            from yask_tpu.ops.pallas_stencil import skew_eligible
+            skw = (self._opts.mode == "pallas"
+                   and self._opts.skew_wavefront
+                   and skew_eligible(self._program, K))
             return self._program.hbm_bytes_per_point(
-                fuse_steps=max(1, self._opts.wf_steps), block=blk)
+                fuse_steps=K, block=blk, skew=skw)
         return self._program.hbm_bytes_per_point()
 
     def get_stats(self) -> yk_stats:

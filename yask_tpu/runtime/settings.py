@@ -66,6 +66,12 @@ class KernelSettings:
         # pallas-mode pads are planned up to radius × this at prepare
         # time so the walk can *grow* K, not only shrink it.
         self.tune_max_wf_steps = 16
+        # Streaming skewed-wavefront tiling on the pallas path (zero
+        # redundant compute in the stream dim; the TPU-native answer to
+        # the reference's two-phase trapezoid blocking, setup.cpp:863).
+        # True = auto (on when the geometry is eligible), False = force
+        # the uniform trapezoid shrink.
+        self.skew_wavefront = True
         # Pallas VMEM budget in MiB (0 = auto: ~16 MiB/core on real TPU
         # per the hardware guide, a loose 100 MiB under CPU interpret
         # where VMEM is emulated). The reference exposes every size knob
@@ -126,6 +132,10 @@ class KernelSettings:
             "tune_max_wf_steps", "Largest wf_steps the auto-tuner may "
             "try (pallas pads are pre-planned to cover it).", self,
             "tune_max_wf_steps")
+        parser.add_bool_option(
+            "skew", "Streaming skewed-wavefront tiling on the pallas "
+            "path (auto-on when eligible; the trapezoid-blocking "
+            "analog).", self, "skew_wavefront")
         parser.add_int_option(
             "vmem_mb", "Pallas VMEM budget in MiB (0 = derive from the "
             "device).", self, "vmem_budget_mb")
