@@ -186,3 +186,36 @@ def test_sincos_pairing_counted_once():
         eq.accept(unpaired)
     assert ana.counters.num_ops == \
         unpaired.num_ops - ana.counters.num_paired
+
+
+def test_partial_dim_write_race_rejected():
+    """Writing a var that lacks a domain dim while the RHS (or a
+    condition) varies along that dim is an intra-step race: every point
+    of the missing extent would demand a different stored value.  The
+    reference cannot express this (its loop nest is the LHS var's dims,
+    Eqs.cpp:364-470); here it must raise."""
+    import pytest
+    from yask_tpu import YaskException
+    from yask_tpu.compiler.solution import yc_factory
+
+    def build(bad):
+        soln = yc_factory().new_solution("pw_race")
+        t = soln.new_step_index("t")
+        x = soln.new_domain_index("x")
+        y = soln.new_domain_index("y")
+        a = soln.new_var("A", [t, x, y])
+        p = soln.new_var("P", [t, y])
+        if bad == "rhs":
+            p(t + 1, y).EQUALS(a(t, x, y) * 0.5)
+        elif bad == "cond":
+            p(t + 1, y).EQUALS(p(t, y) * 0.5).IF_DOMAIN(x >= 4)
+        else:
+            p(t + 1, y).EQUALS(p(t, y) * 0.5)
+        a(t + 1, x, y).EQUALS(a(t, x, y) * 0.5 + p(t, y) * 0.1)
+        return soln
+
+    build("ok").compile()   # constant along x: fine
+    with pytest.raises(YaskException, match="race"):
+        build("rhs").compile()
+    with pytest.raises(YaskException, match="race"):
+        build("cond").compile()

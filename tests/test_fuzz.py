@@ -30,6 +30,12 @@ def random_solution(rng, idx):
             coeff = soln.new_var("k", dims)
     # scratch var: written from the vars, read at offsets by final eqs
     scratch = soln.new_scratch_var("s", dims) if rng.rand() < 0.4 else None
+    # partial-dim WRITTEN var (lacks the first domain dim, keeps the
+    # minor): its RHS must be constant along the missing dim, so it only
+    # reads itself/constants; full vars read it back (broadcast)
+    pvar = None
+    if len(dims) >= 2 and rng.rand() < 0.4:
+        pvar = soln.new_var("pv", [t] + dims[1:])
 
     def rand_expr(depth=0, allow_scratch=False):
         r = rng.rand()
@@ -51,6 +57,9 @@ def random_solution(rng, idx):
         if r < 0.58 and allow_scratch and scratch is not None:
             offs = [int(rng.randint(-2, 3)) for _ in dims]
             return scratch(*[d + o for d, o in zip(dims, offs)])
+        if r < 0.62 and pvar is not None:
+            offs = [int(rng.randint(-1, 2)) for _ in dims[1:]]
+            return pvar(t, *[d + o for d, o in zip(dims[1:], offs)])
         a = rand_expr(depth + 1, allow_scratch)
         b = rand_expr(depth + 1, allow_scratch)
         op = rng.choice(["+", "-", "*"])
@@ -62,6 +71,12 @@ def random_solution(rng, idx):
 
     if scratch is not None:
         scratch(*dims).EQUALS(rand_expr(depth=1) * 0.3)
+    if pvar is not None:
+        prhs = pvar(t, *dims[1:]) * 0.6 + E.ConstExpr(0.05)
+        if rng.rand() < 0.5:
+            prhs = prhs + pvar(
+                t, *[d + 1 for d in dims[1:]]) * 0.1
+        pvar(t + 1, *dims[1:]).EQUALS(prhs)
     for v in vars_:
         rhs = rand_expr(allow_scratch=True) * 0.2 + v(t, *dims) * 0.5
         eq = v(t + 1, *dims).EQUALS(rhs)
@@ -106,7 +121,12 @@ def test_fuzzed_solution_jit_matches_oracle(seed):
 
     # ...and the explicit distributed path (scratch/misc structures
     # through the ghost-exchange planner), BOTH refresh hooks: the
-    # overlap split and the plain per-stage hook
+    # overlap split and the plain per-stage hook.  Partial-dim written
+    # vars are sound here by construction: the analysis race rule
+    # guarantees their RHS is constant along missing dims, so a var
+    # lacking the sharded dim is updated identically on every rank
+    # (replicated write), and one sharded along its own dims exchanges
+    # like any other var.
     dims = soln.domain_dim_names()
     if len(dims) >= 2:
         def run_sharded(overlap):

@@ -328,3 +328,74 @@ def test_tuned_pad_replan_shrinks_and_migrates(env):
     ref = mk("jit", tune=False)
     ref.run_solution(0, 3)
     assert ctx.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+def _partial_written_solution():
+    """3-D solution with a written var lacking the x (lead) dim: the
+    RHS is constant along x (XLA `_to_var_layout` contract), full vars
+    read it back broadcast — the last residual fast-path exclusion from
+    VERDICT r2 (reference handles every declared var,
+    stencil_calc.cpp:40-289)."""
+    from yask_tpu.compiler.solution import yc_factory
+    soln = yc_factory().new_solution("partial_written")
+    t = soln.new_step_index("t")
+    x = soln.new_domain_index("x")
+    y = soln.new_domain_index("y")
+    z = soln.new_domain_index("z")
+    a = soln.new_var("A", [t, x, y, z])
+    p = soln.new_var("P", [t, y, z])
+    p(t + 1, y, z).EQUALS(p(t, y, z) * 0.7 + p(t, y + 1, z - 1) * 0.2
+                          + 0.05)
+    a(t + 1, x, y, z).EQUALS(
+        a(t, x, y, z) * 0.6 + a(t, x + 1, y - 1, z) * 0.2
+        + p(t + 1, y, z) * 0.1)
+    return soln
+
+
+@pytest.mark.parametrize("wf", [1, 2, 3])
+def test_pallas_partial_written_var(env, wf):
+    soln = _partial_written_solution()
+    ok, why = pallas_applicable(soln.compile())
+    assert ok, why
+
+    def run(mode):
+        ctx = yk_factory().new_solution(env, soln)
+        ctx.apply_command_line_options("-g 16")
+        ctx.get_settings().mode = mode
+        ctx.get_settings().wf_steps = wf
+        ctx.prepare_solution()
+        from yask_tpu.runtime.init_utils import init_solution_vars
+        init_solution_vars(ctx, seed=0.03)
+        ctx.run_solution(0, 3)
+        return ctx
+
+    p, ref = run("pallas"), run("jit")
+    assert p.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+def test_pallas_partial_written_with_condition(env):
+    """Conditional write to a partial-dim var: unselected points keep
+    evicted-slot values through the collapsed write."""
+    from yask_tpu.compiler.solution import yc_factory
+    soln = yc_factory().new_solution("partial_written_cond")
+    t = soln.new_step_index("t")
+    x = soln.new_domain_index("x")
+    y = soln.new_domain_index("y")
+    a = soln.new_var("A", [t, x, y])
+    p = soln.new_var("P", [t, y])
+    p(t + 1, y).EQUALS(p(t, y) * 0.8 + 0.1).IF_DOMAIN(y >= 4)
+    a(t + 1, x, y).EQUALS(a(t, x, y) * 0.5 + p(t, y) * 0.3)
+
+    def run(mode):
+        ctx = yk_factory().new_solution(env, soln)
+        ctx.apply_command_line_options("-g 16")
+        ctx.get_settings().mode = mode
+        ctx.get_settings().wf_steps = 2
+        ctx.prepare_solution()
+        from yask_tpu.runtime.init_utils import init_solution_vars
+        init_solution_vars(ctx, seed=0.05)
+        ctx.run_solution(0, 3)
+        return ctx
+
+    p_, ref = run("pallas"), run("jit")
+    assert p_.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Probe the axon TPU relay every ~3 min; run the first-session protocol
+# the moment it answers (the relay window has been short all round —
+# CLAUDE.md "Environment gotchas").  One-shot: exits after one session.
+LOG=${1:-/tmp/tpu_session_auto.log}
+while true; do
+    if timeout 100 python - <<'EOF' >/dev/null 2>&1
+import subprocess, sys
+r = subprocess.run([sys.executable, "-c", "import jax; jax.devices()"],
+                   capture_output=True, timeout=90)
+sys.exit(r.returncode)
+EOF
+    then
+        echo "$(date -u +%H:%M:%S) relay UP - running session" >> "$LOG"
+        python tools/tpu_session.py -g 512 --quick >> "$LOG" 2>&1
+        echo "$(date -u +%H:%M:%S) session exit $?" >> "$LOG"
+        exit 0
+    fi
+    echo "$(date -u +%H:%M:%S) relay down" >> "$LOG"
+    sleep 170
+done

@@ -136,6 +136,27 @@ class SolutionAnalysis:
             for d, val in lhs.misc_vals().items():
                 var.update_misc_range(d, val)
 
+            # A write to a var lacking some solution domain dims must
+            # not read anything that varies along those dims: every
+            # point of the missing extent would demand a different value
+            # for the single stored slab — an intra-step race.  (The
+            # reference cannot even express this: its loop nest is the
+            # LHS var's dims, Eqs.cpp:364-470.)  All lowering backends
+            # then agree on collapsing the constant extent.
+            lhs_dd = set(var.domain_dim_names())
+            missing = [d for d in self.domain_dims if d not in lhs_dd]
+            if missing:
+                from yask_tpu.compiler.expr import used_domain_dims
+                varying = used_domain_dims(
+                    eq.rhs, eq.cond, eq.step_cond) & set(missing)
+                if varying:
+                    raise YaskException(
+                        f"'{eq.format_simple()}' writes var "
+                        f"'{var.get_name()}' (no dim "
+                        f"{sorted(varying)}) but its RHS/condition "
+                        f"varies along {sorted(varying)} — an "
+                        "intra-step race")
+
             # Scan RHS (and conditions) reads: halos, misc ranges, steps.
             pv = PointVisitor()
             eq.rhs.accept(pv)

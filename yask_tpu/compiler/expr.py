@@ -883,6 +883,28 @@ def uses_misc_index(*exprs) -> bool:
     return v.found
 
 
+def used_domain_dims(*exprs) -> set:
+    """Names of domain dims an expression's VALUE can vary along: via
+    domain-index values or var-point reads (a read varies along every
+    domain dim of its var).  ``first/last_domain_index`` are run-time
+    constants and do not count."""
+    names: set = set()
+
+    class _DV(ExprVisitor):
+        def visit_index(self, node):
+            if node.type == IndexType.DOMAIN:
+                names.add(node.name)
+
+        def visit_var_point(self, node):
+            names.update(node.get_var().domain_dim_names())
+
+    v = _DV()
+    for e in exprs:
+        if e is not None:
+            e.accept(v)
+    return names
+
+
 def paired_func_eval(ops_func, e: "FuncExpr", args, memo, sincos_args):
     """Evaluate a FuncExpr with sin/cos pairing: when the argument's sin
     AND cos both occur in the solution (``SolutionAnalysis.sincos_args``,

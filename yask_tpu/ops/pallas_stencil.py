@@ -73,16 +73,31 @@ def pallas_applicable(csol) -> Tuple[bool, str]:
     # whole padded line is one VMEM tile, K-fusion included
     minor = ana.domain_dims[-1]
     for v in csol.soln.get_vars():
+        dd = v.domain_dim_names()
         if v.is_written:
-            if v.domain_dim_names() != ana.domain_dims:
-                return False, (f"written var '{v.get_name()}' must span "
-                               "all domain dims")
+            # Partial-dim written vars are supported when they keep the
+            # minor (lane) dim: the RHS is constant along the missing
+            # lead dims (same rule the XLA path's _to_var_layout
+            # applies), so every tile computes the identical slab and
+            # the sequential grid's repeated write-back is benign.  A
+            # written var missing the minor dim would need lane-axis
+            # DMA windows at non-128 offsets (Mosaic rule below).
+            if not dd:
+                return False, (f"written var '{v.get_name()}' has no "
+                               "domain dims (per-step scalar reduction "
+                               "stays on the XLA path)")
+            if dd[-1] != minor:
+                return False, (f"written var '{v.get_name()}' lacks the "
+                               f"minor dim '{minor}' as its last domain "
+                               "dim (Mosaic lane-DMA alignment)")
+            if dd != [d for d in ana.domain_dims if d in dd]:
+                return False, (f"var '{v.get_name()}' declares domain "
+                               "dims out of solution order")
         else:
             # Mosaic DMA windows constrain the lane (last physical) axis
             # to 128-aligned full-extent fetches; a read-only var whose
             # lane axis is a *lead* dim would need pid-dependent lane
             # offsets, which TC vector loads cannot do (probed on v5e).
-            dd = v.domain_dim_names()
             if dd and dd[-1] != minor:
                 return False, (f"read-only var '{v.get_name()}' lacks the "
                                f"minor dim '{minor}' as its last domain "
@@ -290,6 +305,12 @@ def skew_eligible(program, fuse_steps: int) -> bool:
     lead = ana.domain_dims[:-1]
     if fuse_steps < 2 or not lead:
         return False
+    # partial-dim written vars: the write-slab slice index would become
+    # pid-dependent under skewed regions — uniform shrink only
+    for g in program.geoms.values():
+        if g.is_written and not g.is_scratch \
+                and g.domain_dims != ana.domain_dims:
+            return False
     from yask_tpu.compiler.lowering import tpu_tile_dims
     sub_t, _ = tpu_tile_dims(program.dtype)
     r = ana.fused_step_radius().get(lead[-1], 0)
@@ -812,6 +833,24 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                     idxs.append(slice(rs + lo, rs + hi))
             return tuple(idxs)
 
+        def to_var_region(name, val, region):
+            """Slice a full-region value down to a partial-dim var's own
+            axes.  The RHS is constant along the missing lead dims
+            (XLA-path `_to_var_layout` contract), so the cell at global
+            coordinate pid·block — in-domain for every tile by the ceil
+            grid construction — is taken."""
+            g = program.geoms[name]
+            if g.domain_dims == dims:
+                return val
+            idx = []
+            for di, d in enumerate(dims):
+                if d in g.domain_dims:
+                    idx.append(slice(None))
+                else:
+                    lo, _hi = region[di]
+                    idx.append(mL[d] - lo)
+            return val[tuple(idx)]
+
         def tile_update(base, idxs, val):
             # Mosaic TC implements neither dynamic_update_slice nor
             # scatter (probed on TPU v5e), so embed the statically-
@@ -1024,8 +1063,14 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                             sel = sc if sel is None else sel & sc
                         # unselected points keep the base (evicted-slot /
                         # earlier-write) values — ghosts there are zero,
-                        # so the zero-outside-domain invariant holds
+                        # so the zero-outside-domain invariant holds.
+                        # Partial-dim vars collapse to their own axes
+                        # FIRST (the RHS/conditions are constant along
+                        # the missing dims — analysis race rule), so the
+                        # select runs at var width.
+                        val = to_var_region(name, val, region)
                         if sel is not None:
+                            sel = to_var_region(name, sel, region)
                             val = jnp.where(sel, val, base_slice)
                         computed[name] = tile_update(
                             base, region_idxs(name, region, lmisc), val)
