@@ -14,6 +14,7 @@ setup(
     package_data={"yask_tpu.native": ["host.cpp", "Makefile"]},
     python_requires=">=3.10",
     install_requires=["jax", "numpy"],
+    extras_require={"orbax": ["orbax-checkpoint"]},
     entry_points={
         "console_scripts": [
             "yask-tpu=yask_tpu.main:main",

@@ -399,3 +399,32 @@ def test_pallas_partial_written_with_condition(env):
 
     p_, ref = run("pallas"), run("jit")
     assert p_.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+def test_pallas_partial_scratch_var(env):
+    """Partial-dim SCRATCH var (code-review r3): the in-tile scratch
+    eval collapses to the var's own axes like written vars do."""
+    from yask_tpu.compiler.solution import yc_factory
+    soln = yc_factory().new_solution("scratch_partial")
+    t = soln.new_step_index("t")
+    x = soln.new_domain_index("x")
+    y = soln.new_domain_index("y")
+    a = soln.new_var("A", [t, x, y])
+    s = soln.new_scratch_var("s", [y])
+    s(y).EQUALS(3.0)
+    a(t + 1, x, y).EQUALS(a(t, x, y) * 0.5 + s(y + 1) * 0.1)
+    assert pallas_applicable(soln.compile())[0]
+
+    def run(mode):
+        ctx = yk_factory().new_solution(env, soln)
+        ctx.apply_command_line_options("-g 16")
+        ctx.get_settings().mode = mode
+        ctx.get_settings().wf_steps = 2
+        ctx.prepare_solution()
+        from yask_tpu.runtime.init_utils import init_solution_vars
+        init_solution_vars(ctx, seed=0.03)
+        ctx.run_solution(0, 3)
+        return ctx
+
+    p, ref = run("pallas"), run("jit")
+    assert p.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0

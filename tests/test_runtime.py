@@ -319,3 +319,28 @@ def test_direct_define_call_not_rerun():
     s.define()          # creates var A, zero equations
     s.run_define()      # must be a no-op, not a duplicate-var error
     assert len(s.get_soln().get_vars()) == 1
+
+
+def test_checkpoint_orbax_backend(env, tmp_path):
+    """Orbax round trip: resume mid-run and finish identical to an
+    uninterrupted run (async-capable storage backend for distributed
+    states; the npz path stays the default)."""
+    import pytest as _pt0
+    _pt0.importorskip("orbax.checkpoint")
+    ctx = make_heat(env, g=12)
+    ctx.get_var("A").set_elements_in_seq(0.2)
+    ctx.run_solution(0, 2)
+    ck = str(tmp_path / "orbax_snap")
+    ctx.save_checkpoint(ck, backend="orbax")
+    ctx.run_solution(3, 5)
+
+    other = make_heat(env, g=12)
+    other.load_checkpoint(ck, backend="orbax")
+    assert other._cur_step == 3
+    other.run_solution(3, 5)
+    assert other.compare_data(ctx) == 0
+
+    import pytest as _pt
+    from yask_tpu import YaskException
+    with _pt.raises(YaskException, match="backend"):
+        ctx.save_checkpoint(ck, backend="hdf5")

@@ -6,8 +6,14 @@ LOG=${1:-/tmp/tpu_session_auto.log}
 while true; do
     if timeout 100 python - <<'EOF' >/dev/null 2>&1
 import subprocess, sys
-r = subprocess.run([sys.executable, "-c", "import jax; jax.devices()"],
-                   capture_output=True, timeout=90)
+# require the axon/TPU backend, not a CPU fallback — otherwise the
+# one-shot session would be burned on CPU (bench.py _probe_platform
+# does the same check)
+r = subprocess.run(
+    [sys.executable, "-c",
+     "import jax; import sys; sys.exit(0 if jax.default_backend() in "
+     "('axon', 'tpu') else 3)"],
+    capture_output=True, timeout=90)
 sys.exit(r.returncode)
 EOF
     then

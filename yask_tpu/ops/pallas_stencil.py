@@ -18,13 +18,15 @@ HBM traffic per K steps ≈ one read + one write of each var, versus K of
 each for the unfused path — the same arithmetic-intensity win wave-front
 tiling buys the reference.
 
-Applicability (checked by :func:`pallas_applicable`): ≥ 2 domain dims and
-written vars spanning all domain dims (misc axes on them are fine — the
-LHS misc values pin the write position). Multi-stage chains, sub-
+Applicability (checked by :func:`pallas_applicable`): every var's last
+domain dim must be the solution minor (Mosaic lane-DMA alignment) and
+its domain dims must follow solution order.  Multi-stage chains, sub-
 domain/step conditions, scratch-var chains (evaluated in-tile over
-write-halo-expanded regions), misc-dim and partial-dim read-only vars,
-and arbitrary ring depth are all handled in-kernel; the rest falls back
-to the XLA-fused path.
+write-halo-expanded regions), misc-dim vars, partial-dim vars (read,
+written, or scratch — their RHS is constant along the missing dims per
+the analysis race rule), 1-D solutions (one full-lane tile), and
+arbitrary ring depth are all handled in-kernel; the rest falls back to
+the XLA-fused path.
 """
 
 from __future__ import annotations
@@ -59,13 +61,16 @@ def pallas_applicable(csol) -> Tuple[bool, str]:
     """Can this solution run on the Pallas fused path? Supported: multi-
     stage chains (ssg/fsg-class), sub-domain/step conditions (awp-class —
     lowered to in-tile masks over global coordinates), index-value
-    expressions, partial-dim read-only coefficient vars (sponge factors),
-    scratch-var chains evaluated in-tile over expanded regions
-    (tti/swe2d-class), misc-dim vars including written ones (filter
-    kernels — constant LHS misc values pin the write), and any ring
-    allocation (deep time reads, 2nd-order-in-time schemes). Excluded:
-    partial-dim *written* vars (a tile owner for a var lacking grid dims
-    is ambiguous) and 1-D solutions (nothing to tile)."""
+    expressions, partial-dim vars — read-only coefficients (sponge
+    factors), written, and scratch alike, their RHS being constant
+    along the missing dims per the analysis race rule — scratch-var
+    chains evaluated in-tile over expanded regions (tti/swe2d-class),
+    misc-dim vars including written ones (filter kernels — constant LHS
+    misc values pin the write), 1-D solutions (one full-lane tile,
+    empty grid), and any ring allocation (deep time reads,
+    2nd-order-in-time schemes). Excluded: vars whose last domain dim is
+    not the solution minor (Mosaic lane-DMA alignment) and written vars
+    with no domain dims at all."""
     ana = csol.ana
     if not ana.domain_dims:
         return False, "needs >= 1 domain dim"
@@ -426,10 +431,12 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     elif use_skew and (not skew_ok or distributed):
         raise YaskException(
             f"skewed wavefront needs K >= 2, a single-device chunk "
-            f"(distributed ghosts are only radius×K wide), and a stream "
-            f"radius that is a multiple of the sublane tile ({sub_t}); "
-            f"got K={K}, distributed={distributed}, "
-            f"radius={rad.get(sdim, 0) if sdim else 0}")
+            f"(distributed ghosts are only radius×K wide), all written "
+            f"vars spanning every domain dim, and a stream radius that "
+            f"is a multiple of the sublane tile ({sub_t}); got K={K}, "
+            f"distributed={distributed}, "
+            f"radius={rad.get(sdim, 0) if sdim else 0}, partial-written="
+            f"{sorted(g.name for g in program.geoms.values() if g.is_written and not g.is_scratch and g.domain_dims != dims)}")
     R_s = rad.get(sdim, 0) if sdim else 0
     # per-dim tile margins: uniform shrink = radius×K both sides; the
     # skewed stream dim keeps K·r on the left (the write regions shift
@@ -1022,6 +1029,10 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                             val = jnp.asarray(val, dtype=dtype)
                             srshape = tuple(hi - lo for lo, hi in sregion)
                             val = jnp.broadcast_to(val, srshape)
+                            # partial-dim scratch vars collapse to their
+                            # own axes (RHS/cond constant along missing
+                            # dims — analysis race rule)
+                            val = to_var_region(name, val, sregion)
                             base = ev.scratch.get(
                                 name, jnp.zeros(tile_shape(name), dtype))
                             sidx = region_idxs(name, sregion,
@@ -1030,6 +1041,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                                 cm = ev.eval(eq.cond, tiles, computed,
                                              smemo)
                                 cm = jnp.broadcast_to(cm, srshape)
+                                cm = to_var_region(name, cm, sregion)
                                 val = jnp.where(cm, val, base[sidx])
                             ev.scratch[name] = tile_update(base, sidx, val)
                         continue

