@@ -126,19 +126,46 @@ def main(argv=None) -> int:
     prog = create_solution("iso3dfd", radius=8).get_soln().compile().plan(
         IdxTuple(x=gi, y=gi, z=gi),
         extra_pad={"x": (32, 32), "y": (32, 32), "z": (0, 0)})
-    state = prog.alloc_state()
+
+    # Seed INTERIORS (pads must stay zero — the ghost-zero invariant):
+    # a zero state would make every A/B cross-check vacuous, since
+    # iso3dfd is linear homogeneous and zero stays zero.
+    def seeded_init():
+        rng = np.random.RandomState(7)
+        init = {}
+        for name, g in prog.geoms.items():
+            if g.is_scratch:
+                continue
+            a = np.zeros(tuple(g.shape), np.float32)
+            idx = tuple(
+                slice(g.origin[dn], g.origin[dn] + prog.sizes[dn])
+                if kind == "domain" else slice(None)
+                for dn, kind in g.axes)
+            shape = a[idx].shape
+            if name == "vel":
+                a[idx] = 0.0005 + rng.rand(*shape).astype(np.float32) \
+                    * 0.0005
+            else:
+                a[idx] = (rng.rand(*shape).astype(np.float32) - 0.5) * 0.1
+            init[name] = a
+        return init
+
+    state = prog.alloc_state(init=seeded_init())
     interp = plat != "tpu"   # only under YT_TPU_SESSION_FORCE
     from yask_tpu.ops.pallas_stencil import default_vmem_budget
     budget = default_vmem_budget(plat)
 
     def time_chunk(tag, **kw):
+        """Time one chunk variant; returns its one-chunk output state
+        (or None on failure) so A/B stages can cross-validate."""
         try:
             chunk, tb = build_pallas_chunk(prog, interpret=interp,
                                            vmem_budget=budget, **kw)
             fn = chunk if interp else \
                 jax.jit(chunk).lower(state, 0).compile()
-            st = fn(state, 0)
-            jax.block_until_ready(st)
+            st1 = fn(state, 0)
+            jax.block_until_ready(st1)
+            st = st1
             t0 = time.perf_counter()
             for _ in range(5):
                 st = fn(st, 0)
@@ -149,16 +176,36 @@ def main(argv=None) -> int:
                 tile_mib=round(tb / 2**20, 2),
                 secs_per_chunk=round(dt, 5),
                 gpts=round(gi ** 3 * k / dt / 1e9, 2))
+            return st1
         except Exception as e:  # noqa: BLE001
             log(tag, error=str(e)[:300], **kw)
+            return None
 
-    for pipe in (False, True):
-        time_chunk("pipeline_ab", fuse_steps=2, pipeline_dmas=pipe,
-                   skew=False)
-    # skew A/B: uniform shrink vs streaming skewed wavefront, growing K
+    def max_abs_diff(a, b):
+        m = 0.0
+        for n in a:
+            for x, y in zip(a[n], b[n]):
+                m = max(m, float(jax.numpy.max(jax.numpy.abs(x - y))))
+        return m
+
+    unpiped = time_chunk("pipeline_ab", fuse_steps=2,
+                         pipeline_dmas=False, skew=False)
+    piped = time_chunk("pipeline_ab", fuse_steps=2, pipeline_dmas=True,
+                       skew=False)
+    if unpiped is not None and piped is not None:
+        # bit-equality promised by the protocol: double-buffering must
+        # not change values (the aliasing hazard CLAUDE.md documents)
+        log("pipeline_ab", fuse_steps=2,
+            max_abs_diff=float(max_abs_diff(unpiped, piped)))
+    # skew A/B: uniform shrink vs streaming skewed wavefront, growing
+    # K; the two tilings must agree numerically on real Mosaic (first
+    # hardware execution of the carry machinery)
     for k in (2, 4):
-        for sk in (False, True):
-            time_chunk("skew_ab", fuse_steps=k, skew=sk)
+        uni = time_chunk("skew_ab", fuse_steps=k, skew=False)
+        skw = time_chunk("skew_ab", fuse_steps=k, skew=True)
+        if uni is not None and skw is not None:
+            log("skew_ab", fuse_steps=k,
+                max_abs_diff=float(max_abs_diff(uni, skw)))
 
     # 4) joint auto-tune at the bench size.  tune_max_wf_steps stays
     #    small: pads are planned for radius × the cap, so 16 would
