@@ -161,6 +161,37 @@ def run_suite(fac, env, budget_secs=None):
              measure(ctx, gs ** 3, steps), "GPts/s")
         del ctx
 
+    def iso3dfd_bf16():
+        # bf16 halves HBM bytes/point — the bandwidth-roofline lever on
+        # TPU (reference real_bytes=4|8 builds have no half-precision
+        # analog; bf16 is the TPU-native one).  Validation gate compares
+        # bf16 pallas against bf16 jit with bf16-appropriate epsilons.
+        from yask_tpu.compiler.solution_base import create_solution
+        from yask_tpu.runtime.init_utils import init_solution_vars
+
+        def build16(g, mode, wf=0):
+            sb = create_solution("iso3dfd", radius=8)
+            sb.get_soln().set_element_bytes(2)
+            ctx = fac.new_solution(env, sb)
+            ctx.apply_command_line_options(f"-g {g} -wf_steps {wf}")
+            ctx.get_settings().mode = mode
+            ctx.prepare_solution()
+            init_solution_vars(ctx)
+            return ctx
+
+        ref = build16(24, "jit")
+        ref.run_solution(0, 3)
+        p = build16(24, "pallas", wf=2)
+        p.run_solution(0, 3)
+        bad = p.compare_data(ref, epsilon=3e-2, abs_epsilon=3e-2)
+        if bad:
+            raise RuntimeError(f"bf16 pallas mismatches bf16 jit: {bad}")
+        g = 512 if on_tpu else 48
+        ctx = build16(g, "pallas", wf=2)
+        emit(f"iso3dfd r=8 {g}^3 {plat} pallas-K2 bf16",
+             measure(ctx, g ** 3, steps), "GPts/s")
+        del ctx
+
     def awp_decomposed():
         if ndev <= 1:
             return
@@ -176,7 +207,7 @@ def run_suite(fac, env, budget_secs=None):
         del ctx
 
     for fn in (iso3dfd_jit, iso3dfd_pallas, cube_wavefront, ssg_elastic,
-               awp_decomposed):
+               iso3dfd_bf16, awp_decomposed):
         section(fn, t0, budget_secs)
     return list(ROWS)
 
