@@ -7,6 +7,7 @@ of ``bench.py``:
 * cube 27-point with temporal wave-front fusion (wavefront speedup =
   fused K=4 over K=1);
 * ssg staggered elastic (multi-var);
+* iso3dfd in bf16 on the validated pallas path (HBM roofline lever);
 * awp, domain-decomposed with measured halo fraction (multi-device).
 
 Every section is independent (a failure emits an error line and the
@@ -54,9 +55,15 @@ def measure(ctx, g_pts, steps, trials=3):
 
 
 def build(fac, env, name, radius, g, mode, wf=0, ranks=(),
-          measure_halo=False):
+          measure_halo=False, elem_bytes=None):
     from yask_tpu.runtime.init_utils import init_solution_vars
-    ctx = fac.new_solution(env, stencil=name, radius=radius)
+    if elem_bytes:
+        from yask_tpu.compiler.solution_base import create_solution
+        sb = create_solution(name, radius=radius)
+        sb.get_soln().set_element_bytes(elem_bytes)
+        ctx = fac.new_solution(env, sb)
+    else:
+        ctx = fac.new_solution(env, stencil=name, radius=radius)
     opts = f"-g {g} -wf_steps {wf}"
     if measure_halo:
         opts += " -measure_halo"
@@ -69,14 +76,16 @@ def build(fac, env, name, radius, g, mode, wf=0, ranks=(),
     return ctx
 
 
-def validated_pallas(fac, env, name, radius, wf, gv=24, steps=4):
+def validated_pallas(fac, env, name, radius, wf, gv=24, steps=4,
+                     elem_bytes=None, epsilon=1e-3, abs_epsilon=1e-4):
     """Correctness gate: the fused path must match jit on a small domain
     before any timing is trusted (same policy as bench.py)."""
-    ref = build(fac, env, name, radius, gv, "jit")
+    ref = build(fac, env, name, radius, gv, "jit", elem_bytes=elem_bytes)
     ref.run_solution(0, steps - 1)
-    p = build(fac, env, name, radius, gv, "pallas", wf=wf)
+    p = build(fac, env, name, radius, gv, "pallas", wf=wf,
+              elem_bytes=elem_bytes)
     p.run_solution(0, steps - 1)
-    bad = p.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4)
+    bad = p.compare_data(ref, epsilon=epsilon, abs_epsilon=abs_epsilon)
     if bad:
         raise RuntimeError(f"pallas K={wf} mismatches jit at {gv}^3: {bad}")
 
@@ -166,28 +175,11 @@ def run_suite(fac, env, budget_secs=None):
         # TPU (reference real_bytes=4|8 builds have no half-precision
         # analog; bf16 is the TPU-native one).  Validation gate compares
         # bf16 pallas against bf16 jit with bf16-appropriate epsilons.
-        from yask_tpu.compiler.solution_base import create_solution
-        from yask_tpu.runtime.init_utils import init_solution_vars
-
-        def build16(g, mode, wf=0):
-            sb = create_solution("iso3dfd", radius=8)
-            sb.get_soln().set_element_bytes(2)
-            ctx = fac.new_solution(env, sb)
-            ctx.apply_command_line_options(f"-g {g} -wf_steps {wf}")
-            ctx.get_settings().mode = mode
-            ctx.prepare_solution()
-            init_solution_vars(ctx)
-            return ctx
-
-        ref = build16(24, "jit")
-        ref.run_solution(0, 3)
-        p = build16(24, "pallas", wf=2)
-        p.run_solution(0, 3)
-        bad = p.compare_data(ref, epsilon=3e-2, abs_epsilon=3e-2)
-        if bad:
-            raise RuntimeError(f"bf16 pallas mismatches bf16 jit: {bad}")
+        validated_pallas(fac, env, "iso3dfd", 8, wf=2, elem_bytes=2,
+                         epsilon=3e-2, abs_epsilon=3e-2)
         g = 512 if on_tpu else 48
-        ctx = build16(g, "pallas", wf=2)
+        ctx = build(fac, env, "iso3dfd", 8, g, "pallas", wf=2,
+                    elem_bytes=2)
         emit(f"iso3dfd r=8 {g}^3 {plat} pallas-K2 bf16",
              measure(ctx, g ** 3, steps), "GPts/s")
         del ctx

@@ -80,11 +80,30 @@ def plan_blocks(program, fuse_steps: int = 1,
             pl_, pr_ = g.pads[minor]
             minor_ext = max(minor_ext, sizes[minor] + pl_ + pr_)
 
+    # Mosaic keeps each fused sub-step's intermediate values live across
+    # the K-step chain, and spills what the scoped VMEM limit cannot
+    # hold (observed on v5e: a candidate whose *tiles* fit the budget
+    # died in compile with 140 MiB of "register allocator spill slots").
+    # Model that pressure as ~1 extra live tile per written var per
+    # fused sub-step beyond the first, so the planner starts from
+    # blocks a deep fusion can actually compile; the auto-tuner still
+    # explores outward and the build's exact accounting (plus its
+    # compile-failure infeasibility marking) remains the arbiter.
+    nlive = 0
+    for g in program.geoms.values():
+        if not g.is_written or g.is_scratch:
+            continue
+        misc_ext = 1
+        for i, (dn, kind) in enumerate(g.axes):
+            if kind == "misc":
+                misc_ext *= g.shape[i]
+        nlive += misc_ext * max(fuse_steps - 1, 0)
+
     def tile_bytes(blk):
         per = 1
         for d in lead:
             per *= blk[d] + 2 * hK[d]
-        return per * minor_ext * esize * max(nbuf, 1)
+        return per * minor_ext * esize * max(nbuf + nlive, 1)
 
     def overhead(blk):
         """Read-reuse model: fraction of each tile's loads + compute that
