@@ -904,6 +904,154 @@ class StencilContext:
     # CLI parity
     # ------------------------------------------------------------------
 
+    # ------------------------------------------------------------------
+    # full accessor parity (yk_solution_api.hpp) — "grid" names are the
+    # reference's v2-era aliases for vars; vector forms return values in
+    # domain-dim order; thread/NUMA/offload knobs answer truthfully for
+    # a TPU (XLA manages cores; the chip IS the offload device).
+    # ------------------------------------------------------------------
+
+    get_grid = get_var
+    get_grids = get_vars
+    fuse_grids = fuse_vars
+    new_fixed_size_grid = new_fixed_size_var
+
+    def get_num_grids(self) -> int:
+        return self.get_num_vars()
+
+    def get_num_domain_dims(self) -> int:
+        return len(self.get_domain_dim_names())
+
+    def get_first_rank_domain_index(self, dim: str) -> int:
+        return 0    # host APIs present the GLOBAL problem (SPMD inside)
+
+    def get_last_rank_domain_index(self, dim: str) -> int:
+        return self.get_overall_domain_size(dim) - 1
+
+    def _dvec(self, fn):
+        return [fn(d) for d in self.get_domain_dim_names()]
+
+    def get_first_rank_domain_index_vec(self):
+        return self._dvec(self.get_first_rank_domain_index)
+
+    def get_last_rank_domain_index_vec(self):
+        return self._dvec(self.get_last_rank_domain_index)
+
+    def get_overall_domain_size_vec(self):
+        return self._dvec(self.get_overall_domain_size)
+
+    def get_rank_domain_size_vec(self):
+        return self._dvec(self.get_rank_domain_size)
+
+    def set_rank_domain_size_vec(self, sizes) -> None:
+        for d, s in zip(self.get_domain_dim_names(), sizes):
+            self.set_rank_domain_size(d, s)
+
+    def get_block_size_vec(self):
+        return self._dvec(self.get_block_size)
+
+    def set_block_size_vec(self, sizes) -> None:
+        for d, s in zip(self.get_domain_dim_names(), sizes):
+            self.set_block_size(d, s)
+
+    def get_num_ranks_vec(self):
+        return self._dvec(self.get_num_ranks)
+
+    def set_num_ranks_vec(self, ns) -> None:
+        for d, n in zip(self.get_domain_dim_names(), ns):
+            self.set_num_ranks(d, n)
+
+    def get_rank_index(self, dim: str) -> int:
+        return 0    # single-process SPMD: shards are traced, not ranked
+
+    def get_rank_index_vec(self):
+        return self._dvec(self.get_rank_index)
+
+    def set_rank_index(self, dim: str, idx: int) -> None:
+        if idx != 0:
+            raise YaskException(
+                "explicit rank placement is not applicable: shards are "
+                "laid out by the mesh, not per-process (reference "
+                "set_rank_index is for manual MPI layouts)")
+
+    def set_rank_index_vec(self, idxs) -> None:
+        for d, i in zip(self.get_domain_dim_names(), idxs):
+            self.set_rank_index(d, i)
+
+    def get_min_pad_size(self, dim: str) -> int:
+        return self._opts.min_pad_sizes[dim]
+
+    def set_min_pad_size(self, dim: str, size: int) -> None:
+        self._opts.min_pad_sizes[dim] = max(
+            self._opts.min_pad_sizes[dim], int(size))
+
+    def get_step_wrap(self) -> bool:
+        return getattr(self, "_step_wrap", False)
+
+    def set_step_wrap(self, wrap: bool) -> None:
+        """``yk_solution::set_step_wrap``: with wrapping on, var element
+        APIs accept ANY step index and map it onto the ring modulo the
+        allocation (consumed by ``yk_var._slot_for_step``)."""
+        self._step_wrap = bool(wrap)
+
+    def get_num_outer_threads(self) -> int:
+        return 1    # XLA owns core-level parallelism
+
+    def get_num_inner_threads(self) -> int:
+        return 1
+
+    def is_offloaded(self) -> bool:
+        return self._env.get_platform() == "tpu"
+
+    def get_default_numa_preferred(self) -> int:
+        return self._opts.numa_pref
+
+    def set_default_numa_preferred(self, node: int) -> bool:
+        self._opts.numa_pref = int(node)
+        return True
+
+    def get_elapsed_run_secs(self) -> float:
+        return self._run_timer.get_elapsed_secs()
+
+    def get_command_line_values(self) -> str:
+        """Echo the effective option values (reference
+        ``get_command_line_values``)."""
+        o = self._opts
+        dd = self.get_domain_dim_names()
+        parts = [f"-g_{d} {o.global_domain_sizes[d]}" for d in dd]
+        parts += [f"-b_{d} {o.block_sizes[d]}" for d in dd]
+        parts += [f"-nr_{d} {o.num_ranks[d]}" for d in dd]
+        parts += [f"-wf_steps {o.wf_steps}", f"-mode {o.mode}",
+                  f"-vmem_mb {o.vmem_budget_mb}"]
+        return " ".join(parts)
+
+    def exchange_halos(self) -> None:
+        """Force-refresh ghost copies (reference ``exchange_halos``,
+        ``soln_apis.cpp``).  Global-array modes have no persistent
+        ghosts (every run re-derives them); shard-resident state is
+        materialized so the next run re-places and re-exchanges from
+        the authoritative interiors."""
+        self._check_prepared()
+        self._materialize_state()
+        for v in self.get_vars():
+            v._dirty = False
+
+    def alloc_storage(self) -> None:
+        """Allocate any released var rings (bulk alloc happens in
+        prepare_solution; reference splits prepare/alloc)."""
+        self._check_prepared()
+        for v in self.get_vars():
+            v.alloc_storage()
+
+    def end_solution(self) -> None:
+        """Release run resources (reference ``end_solution``): drops
+        var storage and compiled-program caches; re-prepare to run
+        again."""
+        self._jit_cache.clear()
+        self._state = None
+        self._resident = None
+        self._program = None
+
     def apply_command_line_options(self, args) -> List[str]:
         if isinstance(args, str):
             args = args.split()

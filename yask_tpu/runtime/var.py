@@ -103,6 +103,203 @@ class yk_var:
         g = self._geom()
         return g.misc_lo[dim] + g.misc_ext[dim] - 1
 
+    def set_first_misc_index(self, dim: str, idx: int) -> None:
+        """Re-base a misc dim's first index (``yk_var_api.hpp``; before
+        prepare, like the reference's pre-alloc requirement)."""
+        if self._ctx._program is not None:
+            raise YaskException(
+                "cannot re-base misc indices after prepare_solution")
+        v = self._var()
+        ext = v.misc_range[dim][1] - v.misc_range[dim][0]
+        v.misc_range[dim] = (idx, idx + ext)
+
+    # -- full accessor parity (yk_var_api.hpp) -------------------------
+    # The reference distinguishes rank-domain / halo / alloc / "local"
+    # index spaces per dim.  This runtime presents the GLOBAL problem on
+    # every host API (SPMD shards live inside jit), so rank == overall
+    # and "local" == allocation (one address space):
+    #   first_rank_domain_index = 0, last = size−1;
+    #   halo indices extend by the halos, alloc/local by the pads.
+
+    def get_num_domain_dims(self) -> int:
+        return len(self._var().domain_dim_names())
+
+    def get_domain_dim_names(self) -> List[str]:
+        return list(self._var().domain_dim_names())
+
+    def get_misc_dim_names(self) -> List[str]:
+        return [n for n, k in self._geom().axes if k == "misc"]
+
+    def get_step_dim_name(self) -> str:
+        sd = self._var().step_dim()
+        return sd.name if sd is not None else ""
+
+    def get_left_extra_pad_size(self, dim: str) -> int:
+        return self.get_left_pad_size(dim) - self.get_left_halo_size(dim)
+
+    def get_right_extra_pad_size(self, dim: str) -> int:
+        return self.get_right_pad_size(dim) - self.get_right_halo_size(dim)
+
+    def set_left_halo_size(self, dim: str, size: int) -> None:
+        """Grow-only, like ``set_halo_size``: the analysis-computed read
+        radius is the floor (shrinking below it would undersize pads)."""
+        v = self._var()
+        if self._ctx._program is not None:
+            raise YaskException("cannot change halo after prepare_solution")
+        l, r = v.halo.get(dim, (0, 0))
+        v.halo[dim] = (max(l, size), r)
+
+    def set_right_halo_size(self, dim: str, size: int) -> None:
+        v = self._var()
+        if self._ctx._program is not None:
+            raise YaskException("cannot change halo after prepare_solution")
+        l, r = v.halo.get(dim, (0, 0))
+        v.halo[dim] = (l, max(r, size))
+
+    def get_min_pad_size(self, dim: str) -> int:
+        return self._ctx._opts.min_pad_sizes[dim]
+
+    def set_min_pad_size(self, dim: str, size: int) -> None:
+        """Request at least this much pad (``yk_var::set_min_pad_size``).
+        Applied at the next prepare; recorded per dim (a per-var request
+        widens every var — a superset of the reference's guarantee)."""
+        o = self._ctx._opts
+        o.min_pad_sizes[dim] = max(o.min_pad_sizes[dim], int(size))
+
+    set_left_min_pad_size = set_min_pad_size
+    set_right_min_pad_size = set_min_pad_size
+
+    def get_rank_domain_size(self, dim: str) -> int:
+        return self._ctx.get_overall_domain_size(dim)
+
+    def get_first_rank_domain_index(self, dim: str) -> int:
+        return 0
+
+    def get_last_rank_domain_index(self, dim: str) -> int:
+        return self._ctx.get_overall_domain_size(dim) - 1
+
+    def get_first_rank_halo_index(self, dim: str) -> int:
+        return -self.get_left_halo_size(dim)
+
+    def get_last_rank_halo_index(self, dim: str) -> int:
+        return self.get_last_rank_domain_index(dim) \
+            + self.get_right_halo_size(dim)
+
+    def get_first_rank_alloc_index(self, dim: str) -> int:
+        return -self.get_left_pad_size(dim)
+
+    def get_last_rank_alloc_index(self, dim: str) -> int:
+        return self.get_last_rank_domain_index(dim) \
+            + self.get_right_pad_size(dim)
+
+    def get_first_local_index(self, dim: str) -> int:
+        """First allocated index in ``dim`` (one address space: local ==
+        alloc; step dim → oldest valid step, misc → first misc)."""
+        g = self._geom()
+        v = self._var()
+        if v.step_dim() is not None and v.step_dim().name == dim:
+            return self.get_first_valid_step_index()
+        for n, k in g.axes:
+            if n == dim and k == "misc":
+                return self.get_first_misc_index(dim)
+        return self.get_first_rank_alloc_index(dim)
+
+    def get_last_local_index(self, dim: str) -> int:
+        g = self._geom()
+        v = self._var()
+        if v.step_dim() is not None and v.step_dim().name == dim:
+            return self.get_last_valid_step_index()
+        for n, k in g.axes:
+            if n == dim and k == "misc":
+                return self.get_last_misc_index(dim)
+        return self.get_last_rank_alloc_index(dim)
+
+    def get_first_valid_step_index(self) -> int:
+        """Oldest step index currently in the ring
+        (``yk_var_api.hpp:317``).  Metadata-only: answered from the
+        geometry, never materializing device-resident shard state."""
+        nslots = self._geom().num_slots
+        d = self._ctx._csol.ana.step_dir or 1
+        return self._ctx._cur_step - (nslots - 1) * d
+
+    def get_last_valid_step_index(self) -> int:
+        self._geom()
+        return self._ctx._cur_step
+
+    def are_indices_local(self, indices) -> bool:
+        """True when every index is within the allocated (local) bounds
+        (``yk_var_api.hpp:565``)."""
+        names = self.get_dim_names()
+        try:
+            for n, i in zip(names, indices):
+                if not (self.get_first_local_index(n) <= i
+                        <= self.get_last_local_index(n)):
+                    return False
+        except YaskException:
+            return False
+        return True
+
+    # vector forms (the reference's idx_t_vec overloads): values in
+    # declared-dim order
+    def _vec(self, fn, dims=None):
+        return [fn(d) for d in (dims or self.get_dim_names())]
+
+    def get_alloc_size_vec(self):
+        return self._vec(self.get_alloc_size)
+
+    def get_first_local_index_vec(self):
+        return self._vec(self.get_first_local_index)
+
+    def get_last_local_index_vec(self):
+        return self._vec(self.get_last_local_index)
+
+    def get_first_rank_domain_index_vec(self):
+        return self._vec(self.get_first_rank_domain_index,
+                         self.get_domain_dim_names())
+
+    def get_last_rank_domain_index_vec(self):
+        return self._vec(self.get_last_rank_domain_index,
+                         self.get_domain_dim_names())
+
+    def get_first_rank_halo_index_vec(self):
+        return self._vec(self.get_first_rank_halo_index,
+                         self.get_domain_dim_names())
+
+    def get_last_rank_halo_index_vec(self):
+        return self._vec(self.get_last_rank_halo_index,
+                         self.get_domain_dim_names())
+
+    def get_first_rank_alloc_index_vec(self):
+        return self._vec(self.get_first_rank_alloc_index,
+                         self.get_domain_dim_names())
+
+    def get_last_rank_alloc_index_vec(self):
+        return self._vec(self.get_last_rank_alloc_index,
+                         self.get_domain_dim_names())
+
+    def get_rank_domain_size_vec(self):
+        return self._vec(self.get_rank_domain_size,
+                         self.get_domain_dim_names())
+
+    # parity toggles with documented TPU behavior
+    def is_dynamic_step_alloc(self) -> bool:
+        return False   # ring allocations are static (XLA static shapes)
+
+    def get_numa_preferred(self) -> int:
+        return self._ctx._opts.numa_pref
+
+    def set_numa_preferred(self, node: int) -> bool:
+        self._ctx._opts.numa_pref = int(node)   # accepted; HBM is flat
+        return True
+
+    def get_halo_exchange_l1_norm(self) -> int:
+        return getattr(self, "_l1_norm", 0)
+
+    def set_halo_exchange_l1_norm(self, norm: int) -> None:
+        # accepted for parity: exchanges ship rectangular slabs (the
+        # ppermute payload), so the diamond-norm optimization is moot
+        self._l1_norm = int(norm)
+
     # -- storage ----------------------------------------------------------
 
     def is_storage_allocated(self) -> bool:
@@ -132,6 +329,10 @@ class yk_var:
         d = (cur - t) * self._ctx._csol.ana.step_dir
         slot = len(ring) - 1 - d
         if not (0 <= slot < len(ring)):
+            if self._ctx.get_step_wrap():
+                # yk_solution::set_step_wrap(true): any step index is
+                # valid and wraps onto the ring (yk_var_api.hpp:95)
+                return slot % len(ring)
             raise YaskException(
                 f"step {t} of var '{self._name}' not in allocation "
                 f"(current step {cur}, {len(ring)} slot(s))")
@@ -294,21 +495,124 @@ class yk_var:
 
     # -- reductions (yk_var_api.hpp:992-1044) ------------------------------
 
-    def reduce_elements_in_slice(self, op: str, first_indices, last_indices) -> float:
+    # reduction bitmasks (yk_var_api.hpp:965-977)
+    yk_sum_reduction = 0x01
+    yk_sum_squares_reduction = 0x02
+    yk_product_reduction = 0x04
+    yk_max_reduction = 0x08
+    yk_min_reduction = 0x10
+
+    def reduce_elements_in_slice(self, op, first_indices, last_indices):
+        """Reduce a slice.  ``op`` may be a name ('sum', 'product',
+        'min', 'max') returning a float, or a bitmask of the
+        ``yk_*_reduction`` constants returning a
+        :class:`yk_reduction_result` (the reference form,
+        ``yk_var_api.hpp:1060``)."""
         data = self.get_elements_in_slice(first_indices, last_indices)
         data64 = data.astype(np.float64)
-        if op in ("sum", "add"):
-            return float(data64.sum())
-        if op in ("product", "mul"):
-            return float(data64.prod())
-        if op == "min":
-            return float(data64.min())
-        if op == "max":
-            return float(data64.max())
-        raise YaskException(f"unknown reduction '{op}'")
+        if isinstance(op, str):
+            if op in ("sum", "add"):
+                return float(data64.sum())
+            if op in ("product", "mul"):
+                return float(data64.prod())
+            if op == "min":
+                return float(data64.min())
+            if op == "max":
+                return float(data64.max())
+            raise YaskException(f"unknown reduction '{op}'")
+        return yk_reduction_result(int(op), data64)
 
     def sum_elements_in_slice(self, first_indices, last_indices) -> float:
         return self.reduce_elements_in_slice("sum", first_indices, last_indices)
+
+    def _whole_slice(self):
+        names = self.get_dim_names()
+        first = [self.get_first_local_index(d) for d in names]
+        last = [self.get_last_local_index(d) for d in names]
+        # reductions cover the owned domain (not pads: ghost zeros would
+        # poison products/mins)
+        for i, d in enumerate(names):
+            if d in self.get_domain_dim_names():
+                first[i] = self.get_first_rank_domain_index(d)
+                last[i] = self.get_last_rank_domain_index(d)
+        v = self._var()
+        if v.step_dim() is not None:
+            si = names.index(v.step_dim().name)
+            first[si] = last[si] = self.get_last_valid_step_index()
+        return first, last
+
+    def get_sum(self) -> float:
+        f, l = self._whole_slice()
+        return self.reduce_elements_in_slice("sum", f, l)
+
+    def get_sum_squares(self) -> float:
+        f, l = self._whole_slice()
+        data = self.get_elements_in_slice(f, l).astype(np.float64)
+        return float((data * data).sum())
+
+    def get_product(self) -> float:
+        f, l = self._whole_slice()
+        return self.reduce_elements_in_slice("product", f, l)
+
+    def get_max(self) -> float:
+        f, l = self._whole_slice()
+        return self.reduce_elements_in_slice("max", f, l)
+
+    def get_min(self) -> float:
+        f, l = self._whole_slice()
+        return self.reduce_elements_in_slice("min", f, l)
+
+    # -- storage parity (yk_var_api.hpp storage section) ----------------
+
+    def get_num_storage_elements(self) -> int:
+        g = self._geom()
+        per = 1
+        for e in g.shape:
+            per *= int(e)
+        return per * g.num_slots   # metadata only: no state materialize
+
+    def get_num_storage_bytes(self) -> int:
+        return self.get_num_storage_elements() \
+            * np.dtype(self._ctx._program.dtype).itemsize
+
+    def get_raw_storage_buffer(self) -> np.ndarray:
+        """Host copy of the newest ring slot's padded array (the
+        reference returns the raw pointer; device-resident HBM has no
+        host-addressable alias, so this is an explicit materialized
+        copy)."""
+        return np.asarray(self._ring()[-1])
+
+    def alloc_storage(self) -> None:
+        """(Re-)allocate this var's ring, zero-filled (the standalone
+        half of the reference's alloc path; prepare_solution allocates
+        everything in bulk)."""
+        ctx = self._ctx
+        ctx._check_prepared()
+        if self.is_storage_allocated():
+            return
+        g = self._geom()
+        import jax.numpy as jnp
+        ctx._materialize_state()
+        # jnp.zeros is already a placed device array; other vars' rings
+        # keep whatever placement they had (no forced re-transfer)
+        ctx._state[self._name] = [
+            jnp.zeros(tuple(g.shape), ctx._program.dtype)
+            for _ in range(g.num_slots)]
+
+    alloc_data = alloc_storage   # v2 name
+
+    def release_storage(self) -> None:
+        """Drop this var's ring (reference ``release_storage``); call
+        ``alloc_storage`` (or re-prepare) before running again."""
+        ctx = self._ctx
+        if self.is_storage_allocated():
+            ctx._materialize_state()
+            del ctx._state[self._name]
+
+    def is_storage_layout_identical(self, other: "yk_var") -> bool:
+        a, b = self._geom(), other._geom()
+        return a.axes == b.axes and tuple(a.shape) == tuple(b.shape) \
+            and a.num_slots == b.num_slots
 
     # -- misc --------------------------------------------------------------
 
@@ -318,6 +622,56 @@ class yk_var:
 
     def __repr__(self):
         return f"<yk_var '{self._name}'>"
+
+
+class yk_reduction_result:
+    """Result of a mask-form ``reduce_elements_in_slice``
+    (``yk_var_api.hpp:983``): reductions are computed in f64 regardless
+    of the solution precision; asking for one that was not in the mask
+    raises."""
+
+    def __init__(self, mask: int, data64: "np.ndarray"):
+        self._mask = mask
+        self._n = int(data64.size)
+        self._vals = {}
+        if mask & yk_var.yk_sum_reduction:
+            self._vals["sum"] = float(data64.sum())
+        if mask & yk_var.yk_sum_squares_reduction:
+            self._vals["sum_squares"] = float((data64 * data64).sum())
+        if mask & yk_var.yk_product_reduction:
+            self._vals["product"] = float(data64.prod()) if self._n else 1.0
+        if mask & yk_var.yk_max_reduction:
+            self._vals["max"] = float(data64.max()) if self._n \
+                else -float("inf")
+        if mask & yk_var.yk_min_reduction:
+            self._vals["min"] = float(data64.min()) if self._n \
+                else float("inf")
+
+    def get_reduction_mask(self) -> int:
+        return self._mask
+
+    def get_num_elements_reduced(self) -> int:
+        return self._n
+
+    def _get(self, key):
+        if key not in self._vals:
+            raise YaskException(f"reduction '{key}' was not requested")
+        return self._vals[key]
+
+    def get_sum(self) -> float:
+        return self._get("sum")
+
+    def get_sum_squares(self) -> float:
+        return self._get("sum_squares")
+
+    def get_product(self) -> float:
+        return self._get("product")
+
+    def get_max(self) -> float:
+        return self._get("max")
+
+    def get_min(self) -> float:
+        return self._get("min")
 
 
 def _np_set(a, idx, val):
