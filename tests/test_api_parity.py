@@ -245,3 +245,105 @@ def test_reference_kernel_api_names_covered(env):
         var.get_element([-5, 0, 0, 0])
     ctx.set_step_wrap(True)
     var.get_element([-5, 0, 0, 0])   # wraps instead of raising
+
+
+def test_reference_compiler_api_names_covered(env):
+    """Same completeness bar for the COMPILER API headers
+    (yask_compiler_api.hpp, aux/yc_node_api.hpp, aux/yc_solution_api.hpp),
+    plus behavioral checks for the advanced hooks."""
+    from yask_tpu.compiler.solution import yc_factory
+    from yask_tpu.compiler.node_api import yc_node_factory
+    from yask_tpu.compiler.solution_base import yc_solution_base
+    from yask_tpu.compiler import expr as E
+
+    soln = yc_factory().new_solution("yc_parity")
+    nfac = yc_node_factory()
+    t = soln.new_step_index("t")
+    x = soln.new_domain_index("x")
+    y = soln.new_domain_index("y")
+    soln.set_domain_dims([y, x])   # explicit (reversed) ordering
+    assert soln.domain_dim_names() == ["y", "x"]
+    soln.set_domain_dims([x, y])
+    a = soln.new_grid("A", [t, x, y])          # v2 alias
+    s = soln.new_scratch_grid("S", [x, y])
+
+    SOLUTION = """
+        add_eq add_flow_dependency apply_command_line_options
+        call_after_new_solution call_before_output clear_clustering
+        clear_dependencies clear_equations clear_folding get_description
+        get_equations get_grid get_grids get_name get_num_equations
+        get_num_grids get_num_vars get_settings get_target get_var
+        get_vars is_dependency_checker_enabled is_target_set new_grid
+        new_scratch_grid new_scratch_var new_var output_solution
+        set_cluster_mult set_dependency_checker_enabled set_description
+        set_domain_dims set_element_bytes set_fold_len set_name
+        set_step_dim set_target
+    """.split()
+    for name in SOLUTION:
+        assert hasattr(soln, name), f"yc_solution missing {name}"
+
+    FACTORY = """
+        new_step_index new_domain_index new_misc_index
+        new_first_domain_index new_last_domain_index
+        new_const_number_node new_number_node new_negate_node
+        new_add_node new_subtract_node new_multiply_node new_divide_node
+        new_mod_node new_equals_node new_not_equals_node
+        new_less_than_node new_greater_than_node new_not_less_than_node
+        new_not_greater_than_node new_and_node new_or_node new_not_node
+        new_equation_node new_var_point new_relative_var_point
+        new_grid_point new_relative_grid_point
+    """.split()
+    for name in FACTORY:
+        assert hasattr(nfac, name), f"yc_node_factory missing {name}"
+
+    # node-level APIs
+    c = E.ConstExpr(2.0)
+    assert c.get_value() == 2.0
+    c.set_value(3.0)
+    assert c.get_value() == 3.0
+    add = nfac.new_add_node(a(t, x, y), c)
+    if hasattr(add, "get_operands"):   # flattened commutative node
+        assert add.get_num_operands() >= 2
+    p = nfac.new_relative_var_point(a, [0, 1, -1])
+    assert p.domain_offsets() == {"x": 1, "y": -1}
+    eq = nfac.new_equation_node(a(t + 1, x, y), add)
+    assert eq.get_lhs() is not None and eq.get_rhs() is not None
+    assert eq.get_cond() is None
+    assert eq.get_num_nodes() >= 4
+    clone = eq.clone_ast()
+    assert clone.same(eq) and clone is not eq
+    # vars stay SHARED across clones (identities, not AST nodes)
+    assert clone.get_lhs().get_var() is a
+
+    # scratch + manual dependency edge affects evaluation order
+    s(x, y).EQUALS(a(t, x, y) * 0.5)
+    soln.add_flow_dependency(soln.get_equations()[0],
+                             soln.get_equations()[1])
+    soln.analyze()
+    soln.clear_dependencies()
+
+    # var-level parity
+    av = soln.get_var("A")
+    for name in ("set_alloc_size", "set_dynamic_step_alloc",
+                 "is_dynamic_step_alloc", "set_prefetch_dist",
+                 "get_prefetch_dist", "set_step_alloc_size"):
+        assert hasattr(av, name), f"yc_var missing {name}"
+    av.set_prefetch_dist(2)
+    assert av.get_prefetch_dist() == 2
+
+    # registry + hooks
+    assert "iso3dfd" in yc_solution_base.get_registry()
+    ran = []
+    soln2 = yc_factory().new_solution("hooked")
+    t2 = soln2.new_step_index("t")
+    x2 = soln2.new_domain_index("x")
+    b = soln2.new_var("B", [t2, x2])
+    b(t2 + 1, x2).EQUALS(b(t2, x2) * 0.5)
+    soln2.call_before_output(lambda so, out: ran.append("pre-out"))
+    soln2.call_after_new_solution(lambda ks: ran.append("post-new"))
+    import io
+    soln2.set_target("pseudo")
+    soln2.output_solution(io.StringIO())
+    assert ran == ["pre-out"]
+    ctx = yk_factory().new_solution(env, soln2)
+    assert "post-new" in ran and ctx is not None

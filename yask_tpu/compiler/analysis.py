@@ -243,6 +243,23 @@ class SolutionAnalysis:
             for a, b in zip(ws, ws[1:]):
                 self.eq_deps[b].add(a)
 
+        # User-declared edges (yc_solution::add_flow_dependency,
+        # yask_compiler_api.hpp:657): 'from' DEPENDS ON 'to' — i.e.
+        # 'to' evaluates first; the primary channel when the automatic
+        # checker is disabled.
+        for f_eq, t_eq in getattr(self.soln, "_manual_deps", ()):
+            fi = ti = None
+            for i, eq in enumerate(eqs):
+                if eq.same(f_eq):
+                    fi = i
+                if eq.same(t_eq):
+                    ti = i
+            if fi is None or ti is None:
+                raise YaskException(
+                    "add_flow_dependency references an equation not in "
+                    "this solution")
+            self.eq_deps[fi].add(ti)
+
         # Cycle detection via DFS (reference DFS path visitors, Eqs.hpp).
         color = [0] * n  # 0=white 1=grey 2=black
         order: List[int] = []

@@ -92,6 +92,18 @@ class Expr:
         from yask_tpu.compiler.printers import format_expr
         return format_expr(self)
 
+    def clone_ast(self) -> "Expr":
+        """Deep clone of this AST (``yc_expr_node::clone_ast``).  Vars
+        are identities (storage declarations, not AST nodes) and stay
+        shared — ``Var.__deepcopy__`` returns self."""
+        import copy
+        return copy.deepcopy(self)
+
+    def get_num_nodes(self) -> int:
+        """Total node count of this subtree
+        (``yc_expr_node::get_num_nodes``)."""
+        return 1 + sum(c.get_num_nodes() for c in self.get_children())
+
     def __repr__(self):
         return f"<{type(self).__name__} {self.format_simple()}>"
 
@@ -181,6 +193,16 @@ class ConstExpr(NumExpr):
 
     def _key(self):
         return (self.value,)
+
+    def get_value(self) -> float:
+        return self.value
+
+    def set_value(self, val) -> None:
+        """Mutate the constant (``yc_const_number_node::set_value``).
+        Only safe BEFORE the node is registered in an equation: skeys
+        (the CSE identities) are cached on first use."""
+        object.__setattr__(self, "value", float(val))
+        object.__setattr__(self, "_skey", None)
 
     def accept(self, visitor):
         return visitor.visit_const(self)
@@ -274,6 +296,19 @@ class CommutativeExpr(NumExpr):
 
     def __init__(self, args: Sequence[NumExpr]):
         object.__setattr__(self, "args", tuple(_coerce_num(a) for a in args))
+
+    def get_operands(self):
+        """``yc_commutative_number_node::get_operands``."""
+        return list(self.args)
+
+    def get_num_operands(self) -> int:
+        return len(self.args)
+
+    def add_operand(self, arg) -> None:
+        """Append an operand (pre-registration only, like
+        ``set_value``)."""
+        object.__setattr__(self, "args", self.args + (_coerce_num(arg),))
+        object.__setattr__(self, "_skey", None)
 
     @classmethod
     def make(cls, args: Sequence[NumExpr]) -> NumExpr:
@@ -690,6 +725,23 @@ class EqualsExpr(Expr):
         object.__setattr__(self, "rhs", _coerce_num(rhs))
         object.__setattr__(self, "cond", cond)
         object.__setattr__(self, "step_cond", step_cond)
+
+    def get_lhs(self) -> VarPoint:
+        return self.lhs
+
+    def get_rhs(self) -> NumExpr:
+        return self.rhs
+
+    def get_cond(self) -> Optional[BoolExpr]:
+        return self.cond
+
+    def set_cond(self, cond: Optional[BoolExpr]) -> None:
+        """``yc_equation_node::set_cond`` (mutating form of
+        IF_DOMAIN)."""
+        self._replace(cond=cond)
+
+    def set_step_cond(self, cond: Optional[BoolExpr]) -> None:
+        self._replace(step_cond=cond)
 
     def IF_DOMAIN(self, cond: BoolExpr) -> "EqualsExpr":
         """Attach a sub-domain condition (reference ``IF_DOMAIN``). Mutates

@@ -121,3 +121,27 @@ class yc_node_factory:
         if soln is not None:
             soln._register_eq(eq)
         return eq
+
+    # ---- var-point builders + v2 aliases (yc_node_api.hpp) ------------
+
+    def new_number_node(self, val) -> NumExpr:
+        """Coerce a Python number (or pass through a node) —
+        ``yc_node_factory::new_number_node`` / the ``yc_number_any_arg``
+        conversions."""
+        return _coerce_num(val)
+
+    def new_var_point(self, var, index_exprs) -> VarPoint:
+        """Access point from explicit index expressions
+        (``new_var_point``)."""
+        return var(*index_exprs)
+
+    def new_relative_var_point(self, var, dim_offsets) -> VarPoint:
+        """Access point from integer offsets relative to each of the
+        var's declared dims (``new_relative_var_point``)."""
+        args = []
+        for d, o in zip(var.get_dims(), dim_offsets):
+            args.append(d + int(o) if int(o) != 0 else d)
+        return var(*args)
+
+    new_grid_point = new_var_point
+    new_relative_grid_point = new_relative_var_point

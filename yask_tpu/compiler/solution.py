@@ -172,7 +172,10 @@ class yc_solution:
         return None
 
     def domain_dim_names(self) -> List[str]:
-        # Ordered by first var using them (reference orders by declaration).
+        # Explicit order when set_domain_dims was called; else ordered
+        # by first var using them (reference orders by declaration).
+        if getattr(self, "_explicit_domain_dims", None):
+            return list(self._explicit_domain_dims)
         out: List[str] = []
         for v in self._vars.values():
             for d in v.get_dims():
@@ -182,6 +185,27 @@ class yc_solution:
             if idx.type == IndexType.DOMAIN and idx.name not in out:
                 out.append(idx.name)
         return out
+
+    def set_domain_dims(self, dims: Sequence[IndexExpr]) -> None:
+        """Explicitly declare and ORDER the domain dims
+        (``yask_compiler_api.hpp:538``): the order drives memory layout
+        (the last one becomes the lane axis), looping, and rank
+        layout — and covers solutions where no var carries every dim."""
+        names = []
+        for d in dims:
+            if not isinstance(d, IndexExpr) or d.type != IndexType.DOMAIN:
+                raise YaskException(
+                    "set_domain_dims takes domain index nodes")
+            self._indices.setdefault(d.name, d)
+            names.append(d.name)
+        self._explicit_domain_dims = names
+        self._analysis = None
+
+    def set_step_dim(self, dim: IndexExpr) -> None:
+        """Explicitly declare the step dim (``yask_compiler_api.hpp``)."""
+        if not isinstance(dim, IndexExpr) or dim.type != IndexType.STEP:
+            raise YaskException("set_step_dim takes a step index node")
+        self._indices.setdefault(dim.name, dim)
 
     # ---- vars ------------------------------------------------------------
 
@@ -250,6 +274,48 @@ class yc_solution:
         self._eqs.clear()
         self._analysis = None
 
+    # ---- v2 "grid" aliases + advanced hooks (yask_compiler_api.hpp) --
+
+    new_grid = new_var
+    new_scratch_grid = new_scratch_var
+    get_grid = get_var
+    get_grids = get_vars
+    get_num_grids = get_num_vars
+
+    def add_flow_dependency(self, from_eq: EqualsExpr,
+                            to_eq: EqualsExpr) -> None:
+        """Declare that ``from_eq`` evaluates before ``to_eq``
+        (``yask_compiler_api.hpp:657``) — the manual channel when the
+        automatic dependency checker is disabled; edges merge into the
+        analysis dep graph either way."""
+        if not hasattr(self, "_manual_deps"):
+            self._manual_deps = []
+        self._manual_deps.append((from_eq, to_eq))
+        self._analysis = None
+
+    def clear_dependencies(self) -> None:
+        """Remove edges added via ``add_flow_dependency``."""
+        self._manual_deps = []
+        self._analysis = None
+
+    def call_after_new_solution(self, code) -> None:
+        """Register code to run right after the KERNEL solution is
+        constructed (``yask_compiler_api.hpp:515``).  The reference
+        injects a C++ block; here pass a callable taking the kernel
+        solution, or a Python source string executed with
+        ``kernel_soln`` bound."""
+        if not hasattr(self, "_after_new_solution"):
+            self._after_new_solution = []
+        self._after_new_solution.append(code)
+
+    def call_before_output(self, hook) -> None:
+        """Register ``hook(soln, output)`` to run during
+        ``output_solution`` after optimization, before writing
+        (``yask_compiler_api.hpp:486``)."""
+        if not hasattr(self, "_before_output"):
+            self._before_output = []
+        self._before_output.append(hook)
+
     # ---- analysis & output ----------------------------------------------
 
     def analyze(self):
@@ -275,6 +341,8 @@ class yc_solution:
         from yask_tpu.compiler import printers
         target = self._settings.target
         self.analyze()
+        for hook in getattr(self, "_before_output", ()):
+            hook(self, output)
         if target in ("pseudo", "pseudo-long"):
             text = printers.print_pseudo(self, long=target == "pseudo-long")
         elif target in ("dot", "dot-lite"):

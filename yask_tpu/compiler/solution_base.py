@@ -70,6 +70,13 @@ class yc_solution_base:
         self._nfac = yc_node_factory()
         self._defined = False
 
+    @staticmethod
+    def get_registry():
+        """Names of registered stencil solutions (the reference's
+        ``yc_solution_base::get_registry`` over its static factory
+        list)."""
+        return get_registered_solutions()
+
     def __init_subclass__(cls, **kwargs):
         """Wrap each subclass's ``define()`` so ANY successful call —
         including a user calling ``s.define()`` directly before handing

@@ -66,6 +66,11 @@ class Var:
 
     # ---- identity --------------------------------------------------------
 
+    def __deepcopy__(self, memo):
+        # Vars are identities (storage declarations): clone_ast of any
+        # expression referencing one keeps pointing at the same var.
+        return self
+
     def get_name(self) -> str:
         return self._name
 
@@ -132,6 +137,26 @@ class Var:
         if n < 1:
             raise YaskException("step_alloc must be >= 1")
         self._step_alloc = n
+
+    set_alloc_size = set_step_alloc_size   # v2 name
+
+    def set_dynamic_step_alloc(self, enable: bool) -> None:
+        """Accepted for parity (``yc_var::set_dynamic_step_alloc``):
+        XLA's static shapes make every ring allocation fixed at prepare
+        time, so the flag records intent only."""
+        self._dynamic_step_alloc = bool(enable)
+
+    def is_dynamic_step_alloc(self) -> bool:
+        return getattr(self, "_dynamic_step_alloc", False)
+
+    def set_prefetch_dist(self, dist: int) -> None:
+        """Accepted for parity (``yc_var::set_prefetch_dist``): software
+        prefetch is subsumed by the Pallas input-DMA double buffering
+        (pipeline_dmas), which streams the next tile while computing."""
+        self._prefetch_dist = int(dist)
+
+    def get_prefetch_dist(self) -> int:
+        return getattr(self, "_prefetch_dist", 0)
 
     def get_step_alloc_size(self) -> int:
         """#step slots needed (reference lifespan calc, ``Eqs.cpp:1912``):

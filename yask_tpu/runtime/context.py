@@ -91,6 +91,16 @@ class StencilContext:
             "before_run": [], "after_run": []}
         self._trace_dir: Optional[str] = None
 
+        # yc_solution::call_after_new_solution hooks run now — right
+        # after kernel-solution construction, as the reference injects
+        # its code block at the end of yk_factory::new_solution
+        for code in getattr(self._soln, "_after_new_solution", ()):
+            if callable(code):
+                code(self)
+            else:
+                exec(compile(str(code), "<call_after_new_solution>",
+                             "exec"), {"kernel_soln": self})
+
     # ------------------------------------------------------------------
     # identity / settings / vars
     # ------------------------------------------------------------------
