@@ -96,6 +96,46 @@ def _reexec_on_cpu():
               env)
 
 
+_TPU_RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "TPU_RESULTS.jsonl")
+
+
+def _record_tpu_result(line: dict) -> None:
+    """Append a hardware-measured bench line (with timestamp) to the
+    persistent log — the source for ``last_tpu_measured`` when a later
+    capture falls back to CPU. Never fatal."""
+    try:
+        rec = dict(line)
+        rec["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime())
+        with open(_TPU_RESULTS, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except Exception:
+        pass
+
+
+def _last_tpu_result():
+    """Newest END-TO-END hardware-measured record (falls back to the
+    newest per-chunk microbench when no end-to-end record exists —
+    chunk timings exclude host/trial overhead and are not directly
+    comparable). Never fatal."""
+    newest = newest_chunk = None
+    try:
+        with open(_TPU_RESULTS) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                rec = json.loads(ln)
+                if " chunk " in rec.get("metric", ""):
+                    newest_chunk = rec   # file order == time order
+                else:
+                    newest = rec
+    except Exception:
+        pass
+    return newest or newest_chunk
+
+
 def build(fac, env, g, mode="jit", wf=0, radius=8):
     ctx = fac.new_solution(env, stencil="iso3dfd", radius=radius)
     ctx.apply_command_line_options(f"-g {g}")
@@ -254,6 +294,10 @@ def main():
                           f"throughput ({mode})",
                 "value": round(rate, 3),
                 "unit": "GPts/s",
+                # platform as a FIELD, not only in the metric string: a
+                # CPU-fallback vs_baseline of ~0.0001 must be readable
+                # as "relay was down", not a perf collapse (VERDICT r3)
+                "platform": platform,
                 "vs_baseline": round(rate / 500.0, 4),
                 # roofline context (VERDICT r2 item 8): modeled HBM
                 # bytes/point × achieved rate vs the chip's peak
@@ -263,6 +307,15 @@ def main():
             if hbm_peak > 0:
                 line["hbm_roofline"] = round(
                     rate * 1e9 * bytes_pp / hbm_peak, 4)
+            if on_tpu:
+                _record_tpu_result(line)
+            else:
+                # Relay down at capture time: attach the most recent
+                # hardware-measured result (clearly labeled, with its
+                # timestamp) so the artifact still carries a TPU datum.
+                prev = _last_tpu_result()
+                if prev is not None:
+                    line["last_tpu_measured"] = prev
             print(json.dumps(line))
             return 0
         except Exception as e:  # try a smaller domain

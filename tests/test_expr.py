@@ -70,6 +70,25 @@ def test_equals_auto_registration_and_conditions():
     assert soln.get_equations()[0].step_cond is not None
 
 
+def test_set_cond_none_clears_condition():
+    """Explicit None REMOVES a condition (reference yc_node_api.hpp:207:
+    nullptr clears) — ADVICE r3: _replace must not treat None as
+    'keep'."""
+    soln, t, x, y, u = make_soln()
+    eq = u(t + 1, x, y).EQUALS(u(t, x, y) * 0.5)
+    nfac = yc_node_factory()
+    eq = eq.IF_DOMAIN(x > nfac.new_first_domain_index(x))
+    eq = eq.IF_STEP(E.IndexExpr("t", E.IndexType.STEP) >= 2)
+    assert soln.get_equations()[0].cond is not None
+    assert soln.get_equations()[0].step_cond is not None
+    soln.get_equations()[0].set_cond(None)
+    assert soln.get_equations()[0].cond is None
+    # the step condition is untouched by clearing the domain condition
+    assert soln.get_equations()[0].step_cond is not None
+    soln.get_equations()[0].set_step_cond(None)
+    assert soln.get_equations()[0].step_cond is None
+
+
 def test_structural_identity_safe_in_dicts():
     soln, t, x, y, u = make_soln()
     a = u(t, x + 1, y)

@@ -89,6 +89,49 @@ def test_step_indexing_after_run(env):
         v.get_element([0, 0, 0, 0])   # evicted step
 
 
+def test_reverse_time_step_index_ordering(env):
+    """ADVICE r3: for step_dir=-1 the oldest slot has the LARGER step
+    index; first/last must stay numerically ordered so
+    are_indices_local range checks hold."""
+    ctx = yk_factory().new_solution(env, stencil="test_reverse_2d")
+    ctx.apply_command_line_options("-g 8")
+    ctx.prepare_solution()
+    ctx.get_vars()[0].set_elements_in_seq(0.1)  # non-zero: sums differ
+    ctx.run_solution(0, 2)   # reverse: cur_step walks downward
+    v = ctx.get_vars()[0]
+    first = v.get_first_valid_step_index()
+    last = v.get_last_valid_step_index()
+    assert first <= last
+    assert v.are_indices_local([first, 0, 0])
+    assert v.are_indices_local([last, 0, 0])
+    assert not v.are_indices_local([last + 1, 0, 0])
+    # reductions must cover the NEWEST step (cur_step, numerically the
+    # SMALLER index under reverse time), not the numeric max
+    import numpy as np
+    cur = first  # 3 reverse steps from 0 → newest = -3 = min
+    newest = v.get_elements_in_slice([cur, 0, 0], [cur, 7, 7]) \
+        .astype(np.float64)
+    assert v.get_sum() == pytest.approx(newest.sum(), rel=1e-5)
+
+
+def test_end_solution_reports_clear_error(env):
+    """ADVICE r3: after end_solution, accessors must say so (not the
+    misleading 'state was lost' / AttributeError)."""
+    ctx = make_heat(env, g=8)
+    ctx.get_var("A").set_all_elements_same(1.0)
+    ctx.run_solution(0, 1)
+    v = ctx.get_var("A")
+    ctx.end_solution()
+    with pytest.raises(YaskException, match="end_solution was called"):
+        ctx.run_solution(2, 3)
+    with pytest.raises(YaskException, match="end_solution was called"):
+        v.get_element([2, 0, 0, 0])
+    # re-prepare brings the solution back to life
+    ctx.prepare_solution()
+    ctx.get_var("A").set_all_elements_same(1.0)
+    ctx.run_solution(0, 1)
+
+
 def test_wf_chunking_equivalence(env):
     a = make_heat(env)
     a.get_var("A").set_elements_in_seq(0.1)

@@ -736,11 +736,14 @@ class EqualsExpr(Expr):
         return self.cond
 
     def set_cond(self, cond: Optional[BoolExpr]) -> None:
-        """``yc_equation_node::set_cond`` (mutating form of
-        IF_DOMAIN)."""
+        """``yc_equation_node::set_cond`` (mutating form of IF_DOMAIN).
+        An explicit ``None`` REMOVES the condition (reference
+        ``yc_node_api.hpp:207``: nullptr clears)."""
         self._replace(cond=cond)
 
     def set_step_cond(self, cond: Optional[BoolExpr]) -> None:
+        """Like :meth:`set_cond` for the step condition; ``None``
+        removes it."""
         self._replace(step_cond=cond)
 
     def IF_DOMAIN(self, cond: BoolExpr) -> "EqualsExpr":
@@ -752,10 +755,13 @@ class EqualsExpr(Expr):
         """Attach a step condition (reference ``IF_STEP``)."""
         return self._replace(step_cond=cond)
 
-    def _replace(self, cond=None, step_cond=None) -> "EqualsExpr":
+    _KEEP = object()  # sentinel: "leave this condition unchanged"
+
+    def _replace(self, cond=_KEEP, step_cond=_KEEP) -> "EqualsExpr":
         new = EqualsExpr(self.lhs, self.rhs,
-                         cond if cond is not None else self.cond,
-                         step_cond if step_cond is not None else self.step_cond)
+                         self.cond if cond is EqualsExpr._KEEP else cond,
+                         self.step_cond if step_cond is EqualsExpr._KEEP
+                         else step_cond)
         soln = self.lhs.var.get_solution()
         if soln is not None:
             soln._replace_eq(self, new)

@@ -1281,6 +1281,12 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             new_state[name] = list(state[name][nback:]) + news
         return new_state
 
+    # Report the tiling ACTUALLY chosen (skew/pipelining can auto-fall
+    # back during planning) so stats/bench model the kernel that runs,
+    # not the one eligibility predicted (ADVICE r3).
+    chunk.tiling = {"fuse_steps": K, "block": dict(block),
+                    "skew": bool(use_skew), "pipeline_dmas": use_pipe,
+                    "tile_bytes": tile_bytes}
     return chunk, tile_bytes
 
 

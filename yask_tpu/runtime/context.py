@@ -80,6 +80,7 @@ class StencilContext:
         self._rank_offset: Dict[str, int] = {
             d: 0 for d in self._ana.domain_dims}
         self._jit_cache: Dict = {}
+        self._pallas_tiling: Dict = {}  # build key → tiling actually chosen
 
         self._run_timer = YaskTimer()
         self._halo_timer = YaskTimer()
@@ -243,6 +244,7 @@ class StencilContext:
         var geometry → state allocation."""
         for h in self._hooks["before_prepare"]:
             h(self)
+        self._ended = False
         ndev = self._env.get_num_ranks()
         self._opts.adjust_settings(ndev)
 
@@ -320,6 +322,7 @@ class StencilContext:
                       for v in self._soln.get_vars() if not v.is_scratch()}
         self._cur_step = 0
         self._jit_cache.clear()
+        self._pallas_tiling.clear()
         self._halo_frac = {}
         self._halo_xround = {}       # key -> secs per bare exchange round
         self._halo_xround_last = 0.0
@@ -341,6 +344,10 @@ class StencilContext:
 
     def _check_prepared(self):
         if self._program is None:
+            if getattr(self, "_ended", False):
+                raise YaskException(
+                    "end_solution was called; call prepare_solution "
+                    "again to run")
             raise YaskException("prepare_solution has not been called")
 
     def _materialize_state(self) -> None:
@@ -348,6 +355,10 @@ class StencilContext:
         device-resident sharded interiors — the lazy sync point for any
         host-visible var access between shard-mode runs."""
         if self._resident is None and self._state is None:
+            if getattr(self, "_ended", False):
+                raise YaskException(
+                    "end_solution was called; call prepare_solution "
+                    "again to access var data")
             raise YaskException(
                 "solution state was lost (a shard-mode run failed after "
                 "its buffers were donated); call prepare_solution again")
@@ -616,18 +627,25 @@ class StencilContext:
         self._state = new_state
         self._state_on_device = True
         self._jit_cache.clear()
+        self._pallas_tiling.clear()
 
-    def _get_pallas_chunk(self, K: int):
-        """Compiled fused-Pallas chunk for K steps with the current block
-        settings (cached per (K, block) — the auto-tuner varies both)."""
-        import jax
+    def _pallas_build_key(self, K: int):
+        """(cache key, block tuple) for the configured pallas build —
+        single definition so stats can look up the tiling the built
+        kernel actually chose (ADVICE r3)."""
         bs = self._opts.block_sizes
         blk = None
         if any(bs[d] > 0 for d in self._ana.domain_dims[:-1]):
             blk = tuple(bs[d] if bs[d] > 0 else 8
                         for d in self._ana.domain_dims[:-1])
         skw = None if self._opts.skew_wavefront else False
-        key = ("pallas", K, blk, skw)
+        return ("pallas", K, blk, skw), blk, skw
+
+    def _get_pallas_chunk(self, K: int):
+        """Compiled fused-Pallas chunk for K steps with the current block
+        settings (cached per (K, block) — the auto-tuner varies both)."""
+        import jax
+        key, blk, skw = self._pallas_build_key(K)
         if key not in self._jit_cache:
             from yask_tpu.ops.pallas_stencil import build_pallas_chunk
             interp = self._env.get_platform() != "tpu"
@@ -645,6 +663,9 @@ class StencilContext:
                 # with a peer context.
                 fn = jax.jit(chunk).lower(self._state, 0).compile()
             self._jit_cache[key] = fn
+            # only after a successful compile: a Mosaic failure must not
+            # leave stats modeling a tiling that never ran
+            self._pallas_tiling[key] = getattr(chunk, "tiling", None)
             self._compile_secs += time.perf_counter() - t0c
             self._env.trace_msg(
                 f"pallas chunk: K={K}, blocks={blk or 'planner'}, "
@@ -881,6 +902,17 @@ class StencilContext:
                    for d in self._ana.domain_dims[:-1]
                    if self._opts.block_sizes[d] > 0} or None
             K = max(1, self._opts.wf_steps)
+            # Prefer the tiling the built kernel ACTUALLY chose (skew can
+            # auto-fall-back during planning — ADVICE r3); predict only
+            # when nothing has been built yet for this configuration.
+            built = None
+            if self._opts.mode == "pallas":
+                key, _blk, _skw = self._pallas_build_key(K)
+                built = self._pallas_tiling.get(key)
+            if built is not None:
+                return self._program.hbm_bytes_per_point(
+                    fuse_steps=K, block=built["block"],
+                    skew=built["skew"])
             from yask_tpu.ops.pallas_stencil import skew_eligible
             skw = (self._opts.mode == "pallas"
                    and self._opts.skew_wavefront
@@ -1058,9 +1090,11 @@ class StencilContext:
         var storage and compiled-program caches; re-prepare to run
         again."""
         self._jit_cache.clear()
+        self._pallas_tiling.clear()
         self._state = None
         self._resident = None
         self._program = None
+        self._ended = True
 
     def apply_command_line_options(self, args) -> List[str]:
         if isinstance(args, str):

@@ -37,6 +37,10 @@ class yk_var:
         g = self._ctx._program.geoms.get(self._name) if self._ctx._program \
             else None
         if g is None:
+            if getattr(self._ctx, "_ended", False):
+                raise YaskException(
+                    f"var '{self._name}': end_solution was called; call "
+                    "prepare_solution again to access var data")
             raise YaskException(
                 f"var '{self._name}' not available before prepare_solution")
         return g
@@ -215,16 +219,22 @@ class yk_var:
         return self.get_last_rank_alloc_index(dim)
 
     def get_first_valid_step_index(self) -> int:
-        """Oldest step index currently in the ring
+        """Smallest valid step index currently in the ring
         (``yk_var_api.hpp:317``).  Metadata-only: answered from the
-        geometry, never materializing device-resident shard state."""
+        geometry, never materializing device-resident shard state.
+        For reverse-time solutions (step_dir=-1) the oldest slot has the
+        LARGER index, so first/last are ordered numerically (ADVICE r3)
+        to keep ``are_indices_local`` range checks valid."""
         nslots = self._geom().num_slots
         d = self._ctx._csol.ana.step_dir or 1
-        return self._ctx._cur_step - (nslots - 1) * d
+        oldest = self._ctx._cur_step - (nslots - 1) * d
+        return min(oldest, self._ctx._cur_step)
 
     def get_last_valid_step_index(self) -> int:
-        self._geom()
-        return self._ctx._cur_step
+        nslots = self._geom().num_slots
+        d = self._ctx._csol.ana.step_dir or 1
+        oldest = self._ctx._cur_step - (nslots - 1) * d
+        return max(oldest, self._ctx._cur_step)
 
     def are_indices_local(self, indices) -> bool:
         """True when every index is within the allocated (local) bounds
@@ -538,7 +548,9 @@ class yk_var:
         v = self._var()
         if v.step_dim() is not None:
             si = names.index(v.step_dim().name)
-            first[si] = last[si] = self.get_last_valid_step_index()
+            # the NEWEST step is cur_step regardless of step direction
+            # (for reverse time the numeric max is the OLDEST slot)
+            first[si] = last[si] = self._ctx._cur_step
         return first, last
 
     def get_sum(self) -> float:
