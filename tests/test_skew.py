@@ -68,12 +68,44 @@ def test_skew_engages_for_aligned_radius(env):
                                        rtol=2e-5, atol=1e-6)
 
 
-def test_skew_rejects_unaligned_radius(env):
+def test_skew_engages_for_unaligned_radius(env):
+    """r=2 (not a sublane multiple): the write-window shift rounds down
+    to the sublane tile with a widened window; E_sk extra computed
+    width keeps the overlap valid (round-4 eligibility lift).  The
+    chunk must ENGAGE skew (not silently fall back) and agree with the
+    uniform tiling."""
     from yask_tpu.ops.pallas_stencil import build_pallas_chunk
-    ctx = make(env, "pallas", "iso3dfd", r=2, g=32, wf=2)
-    with pytest.raises(YaskException):
-        build_pallas_chunk(ctx._program, fuse_steps=2, interpret=True,
-                           skew=True)
+    ctx = make(env, "pallas", "iso3dfd", r=2, g=32, wf=2,
+               block={"x": 16, "y": 16})
+    prog = ctx._program
+    sk, _ = build_pallas_chunk(prog, fuse_steps=2, block=(16, 16),
+                               interpret=True, skew=True)
+    assert sk.tiling["skew"] is True
+    un, _ = build_pallas_chunk(prog, fuse_steps=2, block=(16, 16),
+                               interpret=True, skew=False)
+    st = {k: list(v) for k, v in ctx._state.items()}
+    a = sk(st, 0)
+    b = un(st, 0)
+    for n in a:
+        for x, y in zip(a[n], b[n]):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("r,wf,block", [
+    (1, 2, {"x": 16, "y": 16}),    # shift 1: rounds to 0, widened window
+    (2, 3, {"x": 16, "y": 16}),    # shifts 2,4: both misaligned
+    (4, 2, {"x": 16, "y": 16}),    # shift 4: half a sublane tile
+])
+def test_skew_misaligned_radius_matches_jit(env, r, wf, block):
+    assert _compare(env, "iso3dfd", r=r, g=32, wf=wf, block=block,
+                    steps=wf * 2) == 0
+
+
+def test_skew_misaligned_radius_cube_r1(env):
+    """27-point radius-1 stencil (every shift misaligned, ring 1)."""
+    assert _compare(env, "cube", r=1, g=32, wf=4,
+                    block={"x": 16, "y": 16}, steps=8) == 0
 
 
 @pytest.mark.parametrize("wf,block", [

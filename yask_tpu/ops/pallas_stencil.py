@@ -316,10 +316,8 @@ def skew_eligible(program, fuse_steps: int) -> bool:
         if g.is_written and not g.is_scratch \
                 and g.domain_dims != ana.domain_dims:
             return False
-    from yask_tpu.compiler.lowering import tpu_tile_dims
-    sub_t, _ = tpu_tile_dims(program.dtype)
     r = ana.fused_step_radius().get(lead[-1], 0)
-    return r > 0 and r % sub_t == 0
+    return r > 0
 
 
 def default_vmem_budget(platform: str) -> int:
@@ -420,10 +418,13 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     sub_t, _lane_t = tpu_tile_dims(program.dtype)
     # carry depth per var = its ring allocation (an upper bound on how
     # many sub-steps back its levels are read).  The per-level write
-    # windows shift by r per sub-step, and the stream dim is the
-    # sublane (tiled) axis of every written var, so HBM window
-    # alignment currently restricts skew to sublane-multiple radii
-    # (r=8 fp32 — the iso3dfd order-16 flagship).
+    # windows shift by r per sub-step; the stream dim is the sublane
+    # (tiled) axis of every full-dim var, so HBM write windows must
+    # keep 8-aligned offsets.  Sublane-multiple radii (r=8 fp32) shift
+    # exactly; other radii round the shift DOWN to the sublane tile and
+    # widen the window by one tile (E_sk extra computed width on the
+    # right makes the widened span valid; consecutive sequential tiles
+    # overwrite the sub_t-wide overlap with identical valid values).
     skew_ok = skew_eligible(program, K)
     use_skew = skew
     if use_skew is None:
@@ -431,21 +432,26 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     elif use_skew and (not skew_ok or distributed):
         raise YaskException(
             f"skewed wavefront needs K >= 2, a single-device chunk "
-            f"(distributed ghosts are only radius×K wide), all written "
-            f"vars spanning every domain dim, and a stream radius that "
-            f"is a multiple of the sublane tile ({sub_t}); got K={K}, "
-            f"distributed={distributed}, "
+            f"(distributed ghosts are only radius×K wide), a stream-dim "
+            f"radius > 0, and all written vars spanning every domain "
+            f"dim; got K={K}, distributed={distributed}, "
             f"radius={rad.get(sdim, 0) if sdim else 0}, partial-written="
             f"{sorted(g.name for g in program.geoms.values() if g.is_written and not g.is_scratch and g.domain_dims != dims)}")
     R_s = rad.get(sdim, 0) if sdim else 0
+    # Misaligned (non-sublane-multiple) stream radii: every skewed
+    # region carries E_sk extra computed width on its right so the
+    # sublane-rounded write windows (shift floored to sub_t, size
+    # +sub_t) stay inside the level's valid span: need E ≥ d + sub_t
+    # with d = shift−floor(shift) < sub_t ⇒ 2·sub_t suffices.
+    E_sk = 2 * sub_t if (use_skew and R_s % sub_t != 0) else 0
     # per-dim tile margins: uniform shrink = radius×K both sides; the
     # skewed stream dim keeps K·r on the left (the write regions shift
-    # left by r per sub-step) but only r on the right
+    # left by r per sub-step) but only r (+E_sk) on the right
     mL = {d: hK[d] for d in lead}
     mR = {d: hK[d] for d in lead}
     if use_skew:
         mL[sdim] = K * R_s
-        mR[sdim] = R_s
+        mR[sdim] = R_s + E_sk
 
     # Every var's leading-dim pads must cover the fused halo, or the DMA
     # start/end would clamp silently and corrupt results: the runtime
@@ -542,8 +548,19 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 "pads or different block sizes")
         return b
 
-    for d in lead:
-        block[d] = _fit_block(d, block[d])
+    try:
+        for d in lead:
+            block[d] = _fit_block(d, block[d])
+    except YaskException:
+        if use_skew and skew is not True:
+            # auto-engaged skew whose wider slabs don't fit the planned
+            # pads (small misaligned radii): uniform tiling still fits
+            return build_pallas_chunk(
+                program, fuse_steps=fuse_steps, block=block_arg,
+                interpret=interpret, vmem_budget=vmem_budget,
+                distributed=distributed, pipeline_dmas=pipeline_dmas,
+                skew=False)
+        raise
 
     var_order = [n for n in sorted(program.geoms)
                  if not program.geoms[n].is_scratch]
@@ -968,11 +985,14 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 for d in lead:
                     if use_skew and d == sdim:
                         # skew: fixed-width region sliding left by r per
-                        # sub-step; stages still consume their margins
+                        # sub-step; stages still consume their margins.
+                        # E_sk extra right width (misaligned radii) rides
+                        # every region so the telescoping validity spans
+                        # keep covering the widened write windows.
                         c_stage = consumed[d] - rad[d] * k
                         lo = mL[d] - (k + 1) * R_s + c_stage
                         region.append((lo, lo + block[d]
-                                       + 2 * (R_s - c_stage)))
+                                       + 2 * (R_s - c_stage) + E_sk))
                     else:
                         region.append((consumed[d],
                                        block[d] + mL[d] + mR[d]
@@ -1151,17 +1171,23 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                         dst_idxs.append(slice(None))
                     elif use_skew and dn == sdim:
                         # level lvl's write region sits shifted left by
-                        # (lvl−1)·r; skew eligibility guarantees the
-                        # shift is sublane-aligned, so the HBM window
-                        # offset stays tile-aligned
+                        # (lvl−1)·r.  Sublane-multiple shifts express
+                        # exactly; others round the shift DOWN to the
+                        # sublane tile and widen the window by one tile:
+                        # both ends stay inside the level's valid span
+                        # (E_sk budgeted it), and the sub_t overlap with
+                        # the next sequential tile re-writes identical
+                        # valid values (src and dst starts share the
+                        # same residue, g.origin ≡ mL+resid (mod 8)).
                         shift = (lvl - 1) * R_s
+                        sh_al = (shift // sub_t) * sub_t
+                        wsz = block[dn] + (sub_t if sh_al != shift
+                                           else 0)
                         src_idxs.append(pl.ds(
-                            mL[dn] - shift + resid[name, dn],
-                            block[dn]))
+                            mL[dn] - sh_al + resid[name, dn], wsz))
                         dst_idxs.append(pl.ds(
-                            g.origin[dn] - shift
-                            + pid[lead.index(dn)] * block[dn],
-                            block[dn]))
+                            g.origin[dn] - sh_al
+                            + pid[lead.index(dn)] * block[dn], wsz))
                     else:
                         di = lead.index(dn)
                         src_idxs.append(pl.ds(mL[dn] + resid[name, dn],

@@ -263,17 +263,20 @@ def _strip_global_interiors(ctx, gprog, names, mesh, specs_for, gsizes):
 
 
 def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
-                         fn_xonly=None):
+                         fn_xonly=None, fn_pack=None):
     """Measured halo breakdown for one compiled variant (reference
     per-phase halo timers, ``context.hpp:318-328``, recast for fused XLA
-    programs). Two calibration points, cached under ``key``:
+    programs). Three calibration points, cached under ``key``:
 
     * halo fraction — time the real program against its no-exchange
       twin; the shortfall is the per-call halo cost INCLUDING overlap
       effects (what the program actually pays);
     * exchange round — time one full-state ghost exchange alone; the
       bare collective cost. halo_cost − rounds×this is the overlap
-      shortfall (scheduling/serialization the collectives induce)."""
+      shortfall (scheduling/serialization the collectives induce);
+    * pack round — the exchange-only program with collectives elided
+      (pad + strip only): the slab-pack share of the round.  round −
+      pack ≈ collective wait, the reference's wait-timer analog."""
     import jax
     import jax.numpy as jnp
 
@@ -299,19 +302,26 @@ def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
     ctx._halo_frac[key] = max(0.0, 1.0 - t_no / t_ex) if t_ex > 0 else 0.0
     if fn_xonly is not None:
         ctx._halo_xround[key] = timed(fn_xonly)
+    if fn_pack is not None:
+        ctx._halo_xpack[key] = timed(fn_pack)
     return ctx._halo_frac[key]
 
 
 def _build_exchange_only(ctx, names, specs_for, slots, nr, lsizes,
                          gsizes, width_scale: int = 1,
                          written_only: bool = False, extra_pad=None,
-                         uniform_widths=None):
+                         uniform_widths=None, exchange=exchange_ghosts):
     """One ghost-exchange round compiled alone: pad, exchange at halo
     widths × ``width_scale``, strip — no compute. The second halo
     calibration point (bare collective cost). ``width_scale``/
     ``written_only`` mirror the shard_pallas per-K-group exchange
     (radius×K ghosts, only the freshly produced slots move); shard_map
-    uses the defaults (per-step halo-width refresh of every buffer)."""
+    uses the defaults (per-step halo-width refresh of every buffer).
+    ``exchange=_no_exchange`` builds the PACK-ONLY twin (pad + strip,
+    no collectives): timing it against the full round splits the bare
+    exchange cost into slab-pack vs collective-wait — the distinction
+    the reference's per-phase MPI timers exist to make
+    (``context.hpp:318-328``)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -328,7 +338,8 @@ def _build_exchange_only(ctx, names, specs_for, slots, nr, lsizes,
                 for d in ana.domain_dims}
         prog = ctx._csol.plan(lsizes, global_sizes=gsizes,
                               rank_offset=offs,
-                              extra_pad=extra_pad or {})
+                              extra_pad=extra_pad or {},
+                              mosaic_align=False)
         out = {}
         for k in names:
             g = prog.geoms[k]
@@ -365,7 +376,7 @@ def _build_exchange_only(ctx, names, specs_for, slots, nr, lsizes,
             for si, a in enumerate(interior_state[k]):
                 p = jnp.pad(a, pads) if pads else a
                 if widths and si >= len(interior_state[k]) - moved:
-                    p = exchange_ghosts(p, g, widths, nr, lsizes)
+                    p = exchange(p, g, widths, nr, lsizes)
                 ring.append(p[tuple(strip)] if pads else p)
             out[k] = ring
         return out
@@ -413,7 +424,9 @@ def run_shard_map(ctx, start: int, n: int) -> None:
 
     # Static local geometry (pads = halos); the traced twin inside the body
     # only differs in rank offsets.
-    local_prog = ctx._csol.plan(lsizes, global_sizes=gsizes)
+    # XLA-only per-shard geometry: no Mosaic alignment (see VarGeom)
+    local_prog = ctx._csol.plan(lsizes, global_sizes=gsizes,
+                                mosaic_align=False)
     gprog = ctx._program
 
     src_state = ctx._resident if ctx._resident is not None else ctx._state
@@ -438,7 +451,7 @@ def run_shard_map(ctx, start: int, n: int) -> None:
             offs = {d: lax.axis_index(d) * lsizes[d] if nr[d] > 1 else 0
                     for d in ana.domain_dims}
             prog = ctx._csol.plan(lsizes, global_sizes=gsizes,
-                                  rank_offset=offs)
+                                  rank_offset=offs, mosaic_align=False)
 
             # 1) pad local blocks (ghost + physical-boundary zeros).
             state = {}
@@ -569,12 +582,17 @@ def run_shard_map(ctx, start: int, n: int) -> None:
             fn_x = _build_exchange_only(
                 ctx, names, specs_for, slots, nr, lsizes,
                 gsizes).lower(interior, tj).compile()
+            fn_p = _build_exchange_only(
+                ctx, names, specs_for, slots, nr, lsizes,
+                gsizes, exchange=_no_exchange) \
+                .lower(interior, tj).compile()
             ctx._compile_secs += time.perf_counter() - t0c
             _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
-                                 fn_xonly=fn_x)
-            del fn_no, fn_x
+                                 fn_xonly=fn_x, fn_pack=fn_p)
+            del fn_no, fn_x, fn_p
         frac = ctx._halo_frac[key]
         ctx._halo_xround_last = ctx._halo_xround.get(key, 0.0)
+        ctx._halo_xpack_last = ctx._halo_xpack.get(key, 0.0)
         cal_secs = time.perf_counter() - t0cal
 
     t0c2 = time.perf_counter()
@@ -868,13 +886,21 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
                 written_only=True, extra_pad=xpad, uniform_widths=xpad) \
                 .lower(interior,
                        jnp.asarray(start, dtype=jnp.int32)).compile()
+            fn_p = _build_exchange_only(
+                ctx, names, specs_for, slots_, nr,
+                opts.rank_domain_sizes, gsizes, width_scale=K,
+                written_only=True, extra_pad=xpad, uniform_widths=xpad,
+                exchange=_no_exchange) \
+                .lower(interior,
+                       jnp.asarray(start, dtype=jnp.int32)).compile()
             ctx._compile_secs += time.perf_counter() - t0c
             _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
-                                 fn_xonly=fn_x)
-            del fn_no, fn_x
+                                 fn_xonly=fn_x, fn_pack=fn_p)
+            del fn_no, fn_x, fn_p
             t0r += time.perf_counter() - t0cal
         frac = ctx._halo_frac[key]
         ctx._halo_xround_last = ctx._halo_xround.get(key, 0.0)
+        ctx._halo_xpack_last = ctx._halo_xpack.get(key, 0.0)
 
     ctx._resident = None   # interior buffers are donated next; any
     #                          failure before this point kept them valid

@@ -297,11 +297,27 @@ class StencilContext:
                 # candidate fails pad validation and caches as inf).
                 K = max(K, self._opts.tune_max_wf_steps)
             step_rad = self._ana.fused_step_radius()
-            for d in self._ana.domain_dims[:-1]:
+            lead = self._ana.domain_dims[:-1]
+            for d in lead:
                 need = step_rad.get(d, 0) * K
+                need_r = need
+                if d == lead[-1] and self._opts.skew_wavefront:
+                    # Misaligned (non-sublane-multiple) stream radii:
+                    # the skewed tiling computes E_sk = 2·sub_t extra
+                    # right width and its widened slabs need the same
+                    # again in rounding room (see pallas_stencil E_sk).
+                    from yask_tpu.compiler.lowering import tpu_tile_dims
+                    sub_t, _ = tpu_tile_dims(self._csol.dtype)
+                    if step_rad.get(d, 0) % sub_t != 0:
+                        need_r = need + 4 * sub_t
                 l, r = extra[d]
-                extra[d] = (max(l, need), max(r, need))
-        self._plan_kwargs = dict(extra_pad=extra, pad_multiple=pad_mult)
+                extra[d] = (max(l, need), max(r, need_r))
+        # Mosaic lane/sublane alignment only serves the manual-DMA Pallas
+        # paths; the XLA/ref paths keep minimal pads (the r3 headline
+        # regression was the lane round-up taxing the jit path).
+        self._plan_kwargs = dict(extra_pad=extra, pad_multiple=pad_mult,
+                                 mosaic_align=mode in ("pallas",
+                                                       "shard_pallas"))
         self._program = self._csol.plan(gsizes, **self._plan_kwargs)
         self._resident = None
         self._state = self._program.alloc_state()
@@ -325,7 +341,9 @@ class StencilContext:
         self._pallas_tiling.clear()
         self._halo_frac = {}
         self._halo_xround = {}       # key -> secs per bare exchange round
+        self._halo_xpack = {}        # key -> secs pack-only (no collective)
         self._halo_xround_last = 0.0
+        self._halo_xpack_last = 0.0
         for h in self._hooks["after_prepare"]:
             h(self)
 
@@ -933,6 +951,7 @@ class StencilContext:
             halo_secs=self._halo_timer.get_elapsed_secs(),
             compile_secs=self._compile_secs,
             halo_exchange_secs=self._halo_xround_last,
+            halo_pack_secs=self._halo_xpack_last,
             read_bytes_pp=rb_pp, write_bytes_pp=wb_pp,
             hbm_peak=self._env.get_hbm_peak_bytes_per_sec())
         return st

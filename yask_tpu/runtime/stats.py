@@ -15,6 +15,7 @@ class yk_stats:
                  nwrites_pp: int, nfpops_pp: int, elapsed: float,
                  halo_secs: float = 0.0, compile_secs: float = 0.0,
                  halo_exchange_secs: float = 0.0,
+                 halo_pack_secs: float = 0.0,
                  read_bytes_pp: float = 0.0, write_bytes_pp: float = 0.0,
                  hbm_peak: float = 0.0):
         self._npts = npts
@@ -26,6 +27,7 @@ class yk_stats:
         self._halo = halo_secs
         self._compile = compile_secs
         self._halo_xround = halo_exchange_secs
+        self._halo_xpack = halo_pack_secs
         self._rb_pp = read_bytes_pp
         self._wb_pp = write_bytes_pp
         self._hbm_peak = hbm_peak
@@ -68,10 +70,21 @@ class yk_stats:
                 if self._elapsed > 0 else 0.0)
 
     def get_halo_exchange_secs(self) -> float:
-        """Calibrated cost of ONE bare ghost-exchange round (collectives
-        only) — the second halo component next to get_halo_secs(), which
-        includes overlap effects."""
+        """Calibrated cost of ONE bare ghost-exchange round (pack +
+        collectives + unpack) — next to get_halo_secs(), which includes
+        overlap effects."""
         return self._halo_xround
+
+    def get_halo_pack_secs(self) -> float:
+        """Slab pack/unpack share of one exchange round (the round with
+        collectives elided) — reference pack/unpack timers,
+        ``context.hpp:318-328``."""
+        return self._halo_xpack
+
+    def get_halo_collective_secs(self) -> float:
+        """Collective-wait share of one exchange round (round − pack) —
+        reference MPI wait-timer analog."""
+        return max(0.0, self._halo_xround - self._halo_xpack)
 
     def get_hbm_bytes_per_point(self) -> float:
         """Modeled HBM traffic (read+write) per point per step."""
@@ -98,6 +111,9 @@ class yk_stats:
                 f"halo-fraction (%): "
                 f"{100.0 * self._halo / self._elapsed if self._elapsed else 0.0:.4g}\n"
                 f"halo-exchange-round (sec): {self._halo_xround:.6g}\n"
+                f"halo-pack (sec): {self._halo_xpack:.6g}\n"
+                f"halo-collective (sec): "
+                f"{self.get_halo_collective_secs():.6g}\n"
                 f"hbm-bytes-per-point (read+write): "
                 f"{self.get_hbm_bytes_per_point():.6g}\n"
                 f"achieved-HBM (GB/s): "

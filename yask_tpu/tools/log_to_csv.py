@@ -31,6 +31,9 @@ KEYS = [
     "num-steps-done",
     "elapsed-time (sec)",
     "halo-time (sec)",
+    "halo-exchange-round (sec)",
+    "halo-pack (sec)",
+    "halo-collective (sec)",
     "compile-time (sec)",
     "num-points-per-step",
     "domain",
