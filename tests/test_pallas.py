@@ -428,3 +428,30 @@ def test_pallas_partial_scratch_var(env):
 
     p, ref = run("pallas"), run("jit")
     assert p.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+def test_plan_blocks_vinstr_cap(env):
+    """The tile planner's vector-instruction cap stops block growth on
+    op-heavy kernels (Mosaic compile-time guard, r3 ssg-K2 pathology):
+    a tight cap must yield strictly smaller tiles than no cap, and the
+    capped plan must still be buildable."""
+    from yask_tpu.ops.tile_planner import plan_blocks
+    from yask_tpu.ops.pallas_stencil import build_pallas_chunk
+    ctx = yk_factory().new_solution(env, stencil="ssg", radius=2)
+    ctx.apply_command_line_options("-g 32")
+    ctx.get_settings().mode = "pallas"
+    ctx.get_settings().wf_steps = 2
+    ctx.prepare_solution()
+    prog = ctx._program
+    free = plan_blocks(prog, fuse_steps=2, vinstr_cap=0)
+    tight = plan_blocks(prog, fuse_steps=2, vinstr_cap=10_000)
+    vol_free = 1
+    vol_tight = 1
+    for d in free:
+        vol_free *= free[d]
+        vol_tight *= tight[d]
+    assert vol_tight < vol_free
+    blk = tuple(tight[d] for d in prog.ana.domain_dims[:-1])
+    chunk, _ = build_pallas_chunk(prog, fuse_steps=2, block=blk,
+                                  interpret=True)
+    assert chunk.tiling["block"] == tight

@@ -196,3 +196,30 @@ def test_skew_off_knob(env):
              block={"x": 24, "y": 24}, skew=False)
     p.run_solution(0, 5)
     assert p.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+def test_skew_auto_engage_is_profit_gated(env):
+    """skew=None auto-engages only when the skew margin model beats
+    uniform shrink: (K+1)·r + E_sk < 2·K·r.  Misaligned small radii
+    (cube r=1) must stay uniform — auto-engaging them regressed the
+    round-4 cube-wavefront proxy 2.07× → 1.26× (E_sk=16 extra width
+    per 32-wide tile).  Explicit skew=True still forces the path."""
+    from yask_tpu.ops.pallas_stencil import build_pallas_chunk
+
+    # r=8 aligned, K=2: profitable (24 vs 32) → auto-skew ON
+    iso = make(env, "pallas", "iso3dfd", r=8, g=48, wf=2,
+               block={"x": 24, "y": 24})
+    ch, _ = build_pallas_chunk(iso._program, fuse_steps=2,
+                               block=(24, 24), interpret=True)
+    assert ch.tiling["skew"] is True
+
+    # r=1 misaligned, K=4: E_sk=16 ⇒ 21 vs 8 → auto-skew OFF
+    cube = make(env, "pallas", "cube", r=1, g=32, wf=4)
+    ch, _ = build_pallas_chunk(cube._program, fuse_steps=4,
+                               interpret=True)
+    assert ch.tiling["skew"] is False
+
+    # …but an explicit skew=True still builds and matches the oracle
+    sk, _ = build_pallas_chunk(cube._program, fuse_steps=4,
+                               interpret=True, skew=True)
+    assert sk.tiling["skew"] is True

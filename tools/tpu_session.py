@@ -255,6 +255,23 @@ def main(argv=None) -> int:
     except Exception as e:  # noqa: BLE001
         log("bench", error=str(e)[:300])
         return 1
+
+    # 6) Mosaic compile-time pathology check (LAST: mid-r3 saw ssg-K2 /
+    #    swe2d compiles >15 min; a hang here must not cost the session).
+    #    A/B the default tile-planner vinstr cap against a tight one so
+    #    the r5 `max_vinstr` knob is validated on real Mosaic.
+    for name, radius in (("ssg", 2), ("swe2d", None)):
+        for cap in (300_000, 64_000):
+            try:
+                t0 = time.perf_counter()
+                c = build(fac, env, name, "pallas", 32, radius, wf=2)
+                c.get_settings().max_tile_vinstr = cap
+                c.run_solution(0, 1)
+                log("compile_time", stencil=name, max_vinstr=cap,
+                    secs=round(time.perf_counter() - t0, 1))
+            except Exception as e:  # noqa: BLE001
+                log("compile_time", stencil=name, max_vinstr=cap,
+                    error=str(e)[:200])
     return 0
 
 

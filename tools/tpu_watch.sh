@@ -1,14 +1,20 @@
 #!/bin/bash
-# Probe the axon TPU relay every ~3 min; run the first-session protocol
-# the moment it answers (the relay window has been short all round —
-# CLAUDE.md "Environment gotchas").  One-shot: exits after one session.
+# Probe the axon TPU relay every ~3 min; run the session protocol on
+# EVERY window it answers (the relay windows have been short and rare —
+# CLAUDE.md "Environment gotchas").  First window runs --quick to bank
+# a number fast; later windows run the full validation matrix.  Each
+# session's artifacts are committed IMMEDIATELY (round 3 lost its
+# hardware numbers by waiting for round end).
+cd "$(dirname "$0")/.." || exit 1
 LOG=${1:-/tmp/tpu_session_auto.log}
+mkdir -p tools/logs
+N=0
 while true; do
     if timeout 100 python - <<'EOF' >/dev/null 2>&1
 import subprocess, sys
 # require the axon/TPU backend, not a CPU fallback — otherwise the
-# one-shot session would be burned on CPU (bench.py _probe_platform
-# does the same check)
+# session would be burned on CPU (bench.py _probe_platform does the
+# same check)
 r = subprocess.run(
     [sys.executable, "-c",
      "import jax; import sys; sys.exit(0 if jax.default_backend() in "
@@ -17,11 +23,23 @@ r = subprocess.run(
 sys.exit(r.returncode)
 EOF
     then
-        echo "$(date -u +%H:%M:%S) relay UP - running session" >> "$LOG"
-        python tools/tpu_session.py -g 512 --quick >> "$LOG" 2>&1
-        echo "$(date -u +%H:%M:%S) session exit $?" >> "$LOG"
-        exit 0
+        N=$((N+1))
+        ARGS="-g 512 --quick"
+        [ "$N" -gt 1 ] && ARGS="-g 512"
+        SLOG="tools/logs/tpu_session_$(date -u +%m%d_%H%M%S).log"
+        echo "$(date -u +%H:%M:%S) relay UP - session $N ($ARGS)" >> "$LOG"
+        timeout 3000 python tools/tpu_session.py $ARGS > "$SLOG" 2>&1
+        echo "$(date -u +%H:%M:%S) session $N exit $?" >> "$LOG"
+        # Commit hardware artifacts the moment they exist.  Only the
+        # session-owned paths are staged so an in-progress working tree
+        # is never swept up; a transient index.lock just defers the
+        # commit to the next window.
+        git add -f TPU_RESULTS.jsonl tools/logs/ 2>/dev/null
+        git commit -m "TPU session $N artifacts (auto-committed by tpu_watch)" \
+            --only TPU_RESULTS.jsonl tools/logs/ >/dev/null 2>&1
+        sleep 60
+    else
+        echo "$(date -u +%H:%M:%S) relay down" >> "$LOG"
+        sleep 170
     fi
-    echo "$(date -u +%H:%M:%S) relay down" >> "$LOG"
-    sleep 170
 done

@@ -338,7 +338,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                        vmem_budget: int = 100 * 2 ** 20,
                        distributed: bool = False,
                        pipeline_dmas: Optional[bool] = None,
-                       skew: Optional[bool] = None):
+                       skew: Optional[bool] = None,
+                       vinstr_cap: int = 300_000):
     """Build ``chunk(state, t0) -> state`` advancing ``fuse_steps`` steps
     in one fused Pallas sweep.
 
@@ -426,9 +427,18 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # right makes the widened span valid; consecutive sequential tiles
     # overwrite the sub_t-wide overlap with identical valid values).
     skew_ok = skew_eligible(program, K)
+    R_s0 = rad.get(sdim, 0) if sdim else 0
+    E_sk_c = 2 * sub_t if R_s0 % sub_t != 0 else 0
     use_skew = skew
     if use_skew is None:
-        use_skew = skew_ok and not distributed
+        # Auto-engage only when the skew margin model beats uniform
+        # shrink: skew computes (K+1)·r + E_sk extra stream-dim width
+        # per tile vs 2·K·r for uniform.  Misaligned small radii lose
+        # to their own E_sk widening (r=1 K=4: 21 vs 8 — the round-4
+        # cube-wavefront proxy regression); explicit skew=True still
+        # forces the path for A/B measurement.
+        use_skew = (skew_ok and not distributed
+                    and (K + 1) * R_s0 + E_sk_c < 2 * K * R_s0)
     elif use_skew and (not skew_ok or distributed):
         raise YaskException(
             f"skewed wavefront needs K >= 2, a single-device chunk "
@@ -437,13 +447,13 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             f"dim; got K={K}, distributed={distributed}, "
             f"radius={rad.get(sdim, 0) if sdim else 0}, partial-written="
             f"{sorted(g.name for g in program.geoms.values() if g.is_written and not g.is_scratch and g.domain_dims != dims)}")
-    R_s = rad.get(sdim, 0) if sdim else 0
+    R_s = R_s0
     # Misaligned (non-sublane-multiple) stream radii: every skewed
     # region carries E_sk extra computed width on its right so the
     # sublane-rounded write windows (shift floored to sub_t, size
     # +sub_t) stay inside the level's valid span: need E ≥ d + sub_t
     # with d = shift−floor(shift) < sub_t ⇒ 2·sub_t suffices.
-    E_sk = 2 * sub_t if (use_skew and R_s % sub_t != 0) else 0
+    E_sk = E_sk_c if use_skew else 0
     # per-dim tile margins: uniform shrink = radius×K both sides; the
     # skewed stream dim keeps K·r on the left (the write regions shift
     # left by r per sub-step) but only r (+E_sk) on the right
@@ -474,7 +484,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     explicit_block = block is not None
     if block is None:
         from yask_tpu.ops.tile_planner import plan_blocks
-        block = plan_blocks(program, fuse_steps=K, vmem_budget=vmem_budget)
+        block = plan_blocks(program, fuse_steps=K, vmem_budget=vmem_budget,
+                            vinstr_cap=vinstr_cap)
     else:
         block = {d: min(b, sizes[d]) for d, b in zip(lead, block)}
 
@@ -559,7 +570,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 program, fuse_steps=fuse_steps, block=block_arg,
                 interpret=interpret, vmem_budget=vmem_budget,
                 distributed=distributed, pipeline_dmas=pipeline_dmas,
-                skew=False)
+                skew=False, vinstr_cap=vinstr_cap)
         raise
 
     var_order = [n for n in sorted(program.geoms)
@@ -681,7 +692,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 program, fuse_steps=fuse_steps, block=block_arg,
                 interpret=interpret, vmem_budget=vmem_budget,
                 distributed=distributed, pipeline_dmas=pipeline_dmas,
-                skew=False)
+                skew=False, vinstr_cap=vinstr_cap)
 
     tile_bytes = in_tile_bytes + work_bytes
     if tile_bytes > vmem_budget:

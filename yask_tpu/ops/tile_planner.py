@@ -29,8 +29,16 @@ def sublane_count(dtype) -> int:
 
 
 def plan_blocks(program, fuse_steps: int = 1,
-                vmem_budget: int = 100 * 2 ** 20) -> Dict[str, int]:
-    """Choose leading-dim block sizes for the Pallas path."""
+                vmem_budget: int = 100 * 2 ** 20,
+                vinstr_cap: int = 300_000) -> Dict[str, int]:
+    """Choose leading-dim block sizes for the Pallas path.
+
+    ``vinstr_cap`` bounds the estimated Mosaic vector-instruction count
+    of one fused kernel (``num_ops × fuse_steps × VREGs/tile``): block
+    growth stops at the cap so op-heavy kernels (ssg, awp, tti) cannot
+    reach tile sizes whose Mosaic schedule blows up compile time
+    (>15 min observed mid-r3 on ssg-K2).  0 disables the cap.
+    """
     ana = program.ana
     dims = ana.domain_dims
     lead = dims[:-1]
@@ -105,6 +113,18 @@ def plan_blocks(program, fuse_steps: int = 1,
             per *= blk[d] + 2 * hK[d]
         return per * minor_ext * esize * max(nbuf + nlive, 1)
 
+    num_ops = getattr(getattr(ana, "counters", None), "num_ops", 0)
+
+    def vinstr(blk):
+        """Estimated Mosaic vector instructions for one fused kernel:
+        each scalar op per point becomes one vector op per VREG of the
+        tile, repeated for every fused sub-step."""
+        per = 1
+        for d in lead:
+            per *= blk[d] + 2 * hK[d]
+        vregs = per * minor_ext / (sub * 128)
+        return num_ops * fuse_steps * vregs
+
     def overhead(blk):
         """Read-reuse model: fraction of each tile's loads + compute that
         is halo overlap recomputed by neighboring tiles — the quantity
@@ -131,6 +151,8 @@ def plan_blocks(program, fuse_steps: int = 1,
             cand = dict(block)
             cand[d] = nb
             if tile_bytes(cand) >= vmem_budget // 2:
+                continue
+            if vinstr_cap and num_ops and vinstr(cand) > vinstr_cap:
                 continue
             ov = overhead(cand)
             if best is None or ov < best[0]:

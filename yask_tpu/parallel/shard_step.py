@@ -665,12 +665,14 @@ def _prep_shard_pallas(ctx, n: int, K: int, blk):
     budget = ctx.vmem_budget()
     chunk, tile_bytes = build_pallas_chunk(
         local_prog, fuse_steps=K, block=blk, interpret=interp,
-        distributed=True, vmem_budget=budget)
+        distributed=True, vmem_budget=budget,
+        vinstr_cap=ctx._opts.max_tile_vinstr)
     chunk_rem = None
     if rem:
         chunk_rem, _ = build_pallas_chunk(
             local_prog, fuse_steps=rem, block=blk, interpret=interp,
-            distributed=True, vmem_budget=budget)
+            distributed=True, vmem_budget=budget,
+            vinstr_cap=ctx._opts.max_tile_vinstr)
     ctx._env.trace_msg(
         f"shard_pallas chunk: K={K}, blocks={blk or 'planner'}, "
         f"tile {tile_bytes / 2**20:.2f} MiB")

@@ -271,7 +271,8 @@ class AutoTuner:
             blk0 = tuple(bs[d] if bs[d] > 0 else 8 for d in lead)
         else:
             planned = plan_blocks(ctx._program, fuse_steps=k0,
-                                  vmem_budget=ctx.vmem_budget())
+                                  vmem_budget=ctx.vmem_budget(),
+                                  vinstr_cap=ctx._opts.max_tile_vinstr)
             blk0 = tuple(planned[d] for d in lead)
         return blk0
 

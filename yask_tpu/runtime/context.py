@@ -669,7 +669,8 @@ class StencilContext:
             interp = self._env.get_platform() != "tpu"
             chunk, tile_bytes = build_pallas_chunk(
                 self._program, fuse_steps=K, block=blk, interpret=interp,
-                vmem_budget=self.vmem_budget(), skew=skw)
+                vmem_budget=self.vmem_budget(), skew=skw,
+                vinstr_cap=self._opts.max_tile_vinstr)
             self._state_to_device()
             t0c = time.perf_counter()
             if interp:

@@ -77,6 +77,14 @@ class KernelSettings:
         # where VMEM is emulated). The reference exposes every size knob
         # via CLI (settings.hpp:200-327); this is the TPU-side analog.
         self.vmem_budget_mb = 0
+        # Cap on the estimated Mosaic vector-instruction count per fused
+        # Pallas kernel (num_ops × wf_steps × VREGs/tile): the tile
+        # planner refuses to grow blocks past it.  Guards against
+        # pathological Mosaic compile times on op-heavy kernels
+        # (ssg-K2/swe2d took >15 min mid-r3); default keeps every
+        # current plan (max observed 281k for iso3dfd-256-K2).
+        # 0 disables the cap.
+        self.max_tile_vinstr = 300_000
         # Misc.
         self.max_threads = 0           # accepted for parity; XLA manages
         self.numa_pref = -1            # accepted for parity
@@ -139,6 +147,10 @@ class KernelSettings:
         parser.add_int_option(
             "vmem_mb", "Pallas VMEM budget in MiB (0 = derive from the "
             "device).", self, "vmem_budget_mb")
+        parser.add_int_option(
+            "max_vinstr", "Cap on estimated Mosaic vector instructions "
+            "per fused kernel (tile-planner growth guard; 0 = off).",
+            self, "max_tile_vinstr")
         parser.add_int_option(
             "max_threads", "Accepted for reference parity.", self,
             "max_threads")
