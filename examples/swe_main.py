@@ -1,11 +1,21 @@
 """Shallow-water-equation mini-app.
 
-Counterpart of the reference's ``src/examples/swe_main.cpp`` (654 LoC):
-drives the kernel API end-to-end — env → solution → domain sizes → prepare →
-init vars (dam-break column) → step loop → slice extraction — and
-self-checks conservation, like the example-tests target.
+Counterpart of the reference's ``src/examples/swe_main.cpp`` (654 LoC,
+``/root/reference/src/examples/swe_main.cpp:80-562``): drives the whole
+kernel API the way that app does — factory → env (ranks, barriers,
+debug/trace routing) → app-level command-line parser (+ the library's
+own option help) → solution introspection (domain/rank/block geometry,
+element bytes) → var init by interior slices → validation *and*
+benchmark modes (the latter with auto-tune + stats, the reference's
+``-bench``) → per-interval step loop with slice extraction → manual
+halo exchange → checkpoint/resume → ``end_solution`` / ``finalize``.
 
-Run: ``python examples/swe_main.py [-g N] [-steps N] [-plot]``
+Validation mode self-checks conservation and wave propagation
+(the reference checks against its MATLAB twin's invariants);
+benchmark mode reports points/s from ``yk_stats``.
+
+Run: ``python examples/swe_main.py [-g N] [-steps N] [-bench]
+[-nr_x N] [-nr_y N] [-plot] [-yask_debug] [-help]``
 """
 
 from __future__ import annotations
@@ -18,30 +28,88 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 from yask_tpu import yk_factory
+from yask_tpu.utils.cli import CommandLineParser
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    g, steps, plot = 64, 50, False
-    it = iter(range(len(argv)))
-    i = 0
-    while i < len(argv):
-        if argv[i] == "-g":
-            g = int(argv[i + 1]); i += 2
-        elif argv[i] == "-steps":
-            steps = int(argv[i + 1]); i += 2
-        elif argv[i] == "-plot":
-            plot = True; i += 1
-        else:
-            print(f"unknown arg {argv[i]}"); return 2
+
+    # ---- app options, via the same typed parser the library CLIs use
+    # (reference: command_line_parser in swe_main.cpp:104-127) ----------
+    class Opts:
+        g = 64
+        steps = 50
+        interval = 0        # steps per run_solution call (0 = all)
+        bench = False
+        plot = False
+        nr_x = 1
+        nr_y = 1
+        yask_debug = False
+        help = False
+        checkpoint = ""
+
+    o = Opts()
+    parser = CommandLineParser()
+    parser.add_int_option("g", "Global domain size per dim.", o, "g")
+    parser.add_int_option("steps", "Total steps to run.", o, "steps")
+    parser.add_int_option("interval", "Steps per run_solution interval "
+                          "(0 = one interval).", o, "interval")
+    parser.add_bool_option("bench", "Benchmark mode: auto-tune + stats "
+                           "instead of validation.", o, "bench")
+    parser.add_bool_option("plot", "ASCII contour of the final height "
+                           "field.", o, "plot")
+    parser.add_int_option("nr_x", "Mesh ranks along x.", o, "nr_x")
+    parser.add_int_option("nr_y", "Mesh ranks along y.", o, "nr_y")
+    parser.add_bool_option("yask_debug", "Enable library trace output.",
+                           o, "yask_debug")
+    parser.add_bool_option("help", "Print help.", o, "help")
+    parser.add_string_option("checkpoint", "Round-trip a checkpoint "
+                             "through this path mid-run.", o,
+                             "checkpoint")
+    rem = parser.parse_args(argv)
+    if rem:
+        print(f"unknown args: {rem}")
+        return 2
 
     fac = yk_factory()
     env = fac.new_env()
+    rank = env.get_rank_index()
+    if o.yask_debug:
+        env.set_trace_enabled(True)
+
     ctx = fac.new_solution(env, stencil="swe2d")
+    if o.help:
+        # app options, then the library's own (reference swe_main
+        # prints both via print_usage + get_command_line_help)
+        import sys as _sys
+        parser.print_help(_sys.stdout)
+        print(ctx.get_command_line_help())
+        return 0
+
+    g, steps = o.g, o.steps
     ctx.apply_command_line_options(f"-g {g}")
+    if o.nr_x * o.nr_y > 1:
+        ctx.set_num_ranks("x", o.nr_x)
+        ctx.set_num_ranks("y", o.nr_y)
+        ctx.get_settings().mode = "shard_map"
+    if o.bench:
+        ctx.get_settings().do_auto_tune = True
     ctx.prepare_solution()
 
-    # Dam break: a raised column of water in a calm pool.
+    # ---- geometry introspection (reference swe_main.cpp:361-404:
+    # overall vs rank domain, block sizes, element bytes) ---------------
+    dims = ctx.get_domain_dim_names()
+    lo = [ctx.get_first_rank_domain_index(d) for d in dims]
+    hi = [ctx.get_last_rank_domain_index(d) for d in dims]
+    print(f"swe2d '{ctx.get_name()}' on {env.get_num_ranks()} device(s); "
+          f"overall {[ctx.get_overall_domain_size(d) for d in dims]}, "
+          f"rank {rank} owns {list(zip(lo, hi))}, "
+          f"blocks {[ctx.get_block_size(d) for d in dims]}, "
+          f"{ctx.get_element_bytes()} B/elem")
+
+    # ---- init: dam break (raised column in a calm pool), written by
+    # interior-coordinate slices exactly like the reference's buffer
+    # writes (swe_main.cpp:431-470) -------------------------------------
     h0 = np.ones((g, g), dtype=np.float32)
     cx = g // 2
     r = g // 8
@@ -53,23 +121,50 @@ def main(argv=None) -> int:
     # dt/dx chosen for CFL stability with c = sqrt(g·h) ≈ sqrt(2·2)
     ctx.get_var("lam").set_element(0.2, [])
     ctx.get_var("grav").set_element(1.0, [])
+    env.global_barrier()
+
+    # a manual ghost refresh is legal any time (reference exchange_halos)
+    ctx.exchange_halos()
 
     mass0 = float(h0.sum())
-    ctx.run_solution(0, steps - 1)
+    interval = o.interval if o.interval > 0 else steps
+    t = 0
+    probe = []   # wave height at the domain center after each interval
+    while t < steps:
+        t1 = min(t + interval, steps)
+        ctx.run_solution(t, t1 - 1)
+        t = t1
+        probe.append(float(ctx.get_var("h").get_element([t, cx, cx])))
+        if o.checkpoint and t < steps:
+            # mid-run checkpoint round-trip (npz/orbax aux subsystem)
+            ctx.save_checkpoint(o.checkpoint)
+            ctx.load_checkpoint(o.checkpoint)
+
     h = ctx.get_var("h").get_elements_in_slice(
         [steps, 0, 0], [steps, g - 1, g - 1])
 
-    # Self-checks (the reference example-tests style): finite field and
-    # near-conserved interior mass (LxF loses a little at open borders).
-    assert np.isfinite(h).all(), "field went non-finite"
-    mass = float(h.sum())
-    drift = abs(mass - mass0) / mass0
-    print(f"swe2d: {steps} steps on {g}x{g}; mass drift {drift:.3%}; "
-          f"h in [{h.min():.3f}, {h.max():.3f}]")
-    assert drift < 0.2, "mass drifted implausibly"
-    assert h.std() > 1e-3, "wave did not propagate"
+    if o.bench:
+        st = ctx.get_stats()
+        print(f"bench: {st.get_num_steps_done()} steps, "
+              f"{st.get_pts_per_sec() / 1e6:.1f} MPts/s "
+              f"(auto-tuned wf_steps={ctx.get_settings().wf_steps})")
+        ctx.reset_auto_tuner(False)
+    else:
+        # ---- self-checks (the reference example-tests style) ----------
+        assert np.isfinite(h).all(), "field went non-finite"
+        mass = float(h.sum())
+        drift = abs(mass - mass0) / mass0
+        print(f"swe2d: {steps} steps on {g}x{g}; mass drift {drift:.3%}; "
+              f"h in [{h.min():.3f}, {h.max():.3f}]")
+        assert drift < 0.2, "mass drifted implausibly"
+        assert h.std() > 1e-3, "wave did not propagate"
+        # the dam-break column collapses: center height must fall, and
+        # the rarefaction must reach the quarter-domain ring
+        assert probe[-1] < 2.0, "dam column never collapsed"
+        ring = float(h[cx, cx + g // 4])
+        assert abs(ring - 1.0) > 1e-4, "wave never reached r=g/4"
 
-    if plot:
+    if o.plot:
         # crude ASCII contour
         q = np.linspace(h.min(), h.max(), 5)
         chars = " .:*#"
@@ -77,6 +172,9 @@ def main(argv=None) -> int:
             print("".join(
                 chars[int(np.searchsorted(q, v, side="right")) - 1]
                 for v in row[:: max(g // 64, 1)]))
+
+    ctx.end_solution()
+    env.finalize()
     print("swe2d example: PASS")
     return 0
 

@@ -223,3 +223,52 @@ def test_skew_auto_engage_is_profit_gated(env):
     sk, _ = build_pallas_chunk(cube._program, fuse_steps=4,
                                interpret=True, skew=True)
     assert sk.tiling["skew"] is True
+
+
+def test_skew_distributed_stream_unsharded(env):
+    """shard_pallas engages the skewed wavefront when the stream dim is
+    not mesh-decomposed (the carry never crosses a shard boundary):
+    oracle equivalence on a 2-shard mesh plus a strictly smaller
+    modeled margin overhead than the uniform distributed tiling — the
+    distributed temporal-blocking analog of the reference's
+    update_tb_info (setup.cpp:863)."""
+    from yask_tpu.runtime.init_utils import init_solution_vars
+
+    def mk(mode, ranks=(), skew=True):
+        ctx = yk_factory().new_solution(env, stencil="iso3dfd", radius=8)
+        ctx.apply_command_line_options("-g 48")
+        ctx.get_settings().mode = mode
+        ctx.get_settings().wf_steps = 2
+        ctx.get_settings().skew_wavefront = skew
+        for d, r in ranks:
+            ctx.set_num_ranks(d, r)
+        ctx.prepare_solution()
+        init_solution_vars(ctx)
+        return ctx
+
+    ref = mk("jit")
+    ref.run_solution(0, 3)
+
+    sp = mk("shard_pallas", ranks=[("x", 2)])
+    sp.run_solution(0, 3)
+    assert sp.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+    til = [t for k, t in sp._pallas_tiling.items()
+           if k[0] == "shard_pallas"]
+    assert til and til[0]["skew"] is True
+
+    un = mk("shard_pallas", ranks=[("x", 2)], skew=False)
+    un.run_solution(0, 3)
+    assert un.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+    til_u = [t for k, t in un._pallas_tiling.items()
+             if k[0] == "shard_pallas"]
+    assert til_u and til_u[0]["skew"] is False
+    assert til[0]["margin_overhead"] < til_u[0]["margin_overhead"]
+
+    # stream dim decomposed -> skew must NOT engage (carry would cross
+    # the shard boundary); uniform tiling still matches
+    sy = mk("shard_pallas", ranks=[("y", 2)])
+    sy.run_solution(0, 3)
+    assert sy.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+    til_y = [t for k, t in sy._pallas_tiling.items()
+             if k[0] == "shard_pallas"]
+    assert til_y and til_y[0]["skew"] is False

@@ -133,15 +133,16 @@ def main(argv=None) -> int:
     # Seed INTERIORS (pads must stay zero — the ghost-zero invariant):
     # a zero state would make every A/B cross-check vacuous, since
     # iso3dfd is linear homogeneous and zero stays zero.
-    def seeded_init():
+    def seeded_init(prog_=None):
+        prog_ = prog_ or prog
         rng = np.random.RandomState(7)
         init = {}
-        for name, g in prog.geoms.items():
+        for name, g in prog_.geoms.items():
             if g.is_scratch:
                 continue
             a = np.zeros(tuple(g.shape), np.float32)
             idx = tuple(
-                slice(g.origin[dn], g.origin[dn] + prog.sizes[dn])
+                slice(g.origin[dn], g.origin[dn] + prog_.sizes[dn])
                 if kind == "domain" else slice(None)
                 for dn, kind in g.axes)
             shape = a[idx].shape
@@ -150,7 +151,7 @@ def main(argv=None) -> int:
                     * 0.0005
             else:
                 a[idx] = (rng.rand(*shape).astype(np.float32) - 0.5) * 0.1
-            init[name] = a
+            init[name] = np.asarray(a, dtype=prog_.dtype)
         return init
 
     state = prog.alloc_state(init=seeded_init())
@@ -158,15 +159,20 @@ def main(argv=None) -> int:
     from yask_tpu.ops.pallas_stencil import default_vmem_budget
     budget = default_vmem_budget(plat)
 
-    def time_chunk(tag, **kw):
+    def time_chunk(tag, prog_=None, state_=None, metric=None, **kw):
         """Time one chunk variant; returns its one-chunk output state
-        (or None on failure) so A/B stages can cross-validate."""
+        (or None on failure) so A/B stages can cross-validate.  The
+        default (prog, state) pair is the fp32 flagship; the bf16 stage
+        passes its own so the timing/recording protocol stays single-
+        definition."""
+        prog_ = prog_ or prog
+        state_ = state_ if state_ is not None else state
         try:
-            chunk, tb = build_pallas_chunk(prog, interpret=interp,
+            chunk, tb = build_pallas_chunk(prog_, interpret=interp,
                                            vmem_budget=budget, **kw)
             fn = chunk if interp else \
-                jax.jit(chunk).lower(state, 0).compile()
-            st1 = fn(state, 0)
+                jax.jit(chunk).lower(state_, 0).compile()
+            st1 = fn(state_, 0)
             jax.block_until_ready(st1)
             st = st1
             t0 = time.perf_counter()
@@ -182,8 +188,8 @@ def main(argv=None) -> int:
             if plat == "tpu":
                 from bench import _record_tpu_result
                 _record_tpu_result({
-                    "metric": f"iso3dfd r=8 {gi}^3 fp32 tpu pallas "
-                              f"chunk ({tag} {kw})",
+                    "metric": metric or (f"iso3dfd r=8 {gi}^3 fp32 tpu "
+                                         f"pallas chunk ({tag} {kw})"),
                     "value": gpts, "unit": "GPts/s", "platform": plat,
                     "vs_baseline": round(gpts / 500.0, 4)})
             return st1
@@ -216,6 +222,25 @@ def main(argv=None) -> int:
         if uni is not None and skw is not None:
             log("skew_ab", fuse_steps=k,
                 max_abs_diff=float(max_abs_diff(uni, skw)))
+
+    # 3b) bf16 A/B: the half-traffic roofline lever.  The CPU proxy
+    #     inverts (bf16 is software-emulated off-TPU) so only this
+    #     hardware row can confirm the >=1.5x target; sublane-16
+    #     geometry is exercised by the same chunk builder, and the
+    #     timing/recording protocol is time_chunk's single definition.
+    try:
+        from yask_tpu.compiler.solution_base import create_solution as _cs
+        sb16 = _cs("iso3dfd", radius=8)
+        sb16.get_soln().set_element_bytes(2)
+        prog16 = sb16.get_soln().compile().plan(
+            IdxTuple(x=gi, y=gi, z=gi),
+            extra_pad={"x": (32, 32), "y": (32, 32), "z": (0, 0)})
+        state16 = prog16.alloc_state(init=seeded_init(prog16))
+        time_chunk("bf16_ab", prog_=prog16, state_=state16,
+                   metric=f"iso3dfd r=8 {gi}^3 bf16 tpu pallas chunk K2",
+                   fuse_steps=2)
+    except Exception as e:  # noqa: BLE001
+        log("bf16_ab", error=str(e)[:300])
 
     # 4) joint auto-tune at the bench size.  tune_max_wf_steps stays
     #    small: pads are planned for radius × the cap, so 16 would

@@ -32,11 +32,16 @@ EOF
         echo "$(date -u +%H:%M:%S) session $N exit $?" >> "$LOG"
         # Commit hardware artifacts the moment they exist.  Only the
         # session-owned paths are staged so an in-progress working tree
-        # is never swept up; a transient index.lock just defers the
-        # commit to the next window.
-        git add -f TPU_RESULTS.jsonl tools/logs/ 2>/dev/null
+        # is never swept up; each pathspec is guarded (a missing
+        # TPU_RESULTS.jsonl — relay dropped before the first bench line
+        # — must not abort staging the session log); a transient
+        # index.lock just defers the commit to the next window.
+        PATHS="tools/logs"
+        [ -f TPU_RESULTS.jsonl ] && PATHS="$PATHS TPU_RESULTS.jsonl"
+        [ -f BENCH_suite_latest.json ] && PATHS="$PATHS BENCH_suite_latest.json"
+        git add -f $PATHS 2>/dev/null
         git commit -m "TPU session $N artifacts (auto-committed by tpu_watch)" \
-            --only TPU_RESULTS.jsonl tools/logs/ >/dev/null 2>&1
+            --only $PATHS >/dev/null 2>&1
         sleep 60
     else
         echo "$(date -u +%H:%M:%S) relay down" >> "$LOG"

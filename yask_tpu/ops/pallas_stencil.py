@@ -303,9 +303,10 @@ class _TileEval:
 
 
 def skew_eligible(program, fuse_steps: int) -> bool:
-    """Would :func:`build_pallas_chunk` auto-engage the skewed wavefront
-    for this (program, K)?  Shared by the build itself and the HBM
-    traffic model so bench/stats describe the tiling actually run."""
+    """CAN the skewed wavefront run for this (program, K)?  Feasibility
+    only — an explicit ``skew=True`` needs just this; the auto-engage
+    decision additionally applies :func:`skew_auto_engages`' profit
+    gate."""
     ana = program.ana
     lead = ana.domain_dims[:-1]
     if fuse_steps < 2 or not lead:
@@ -318,6 +319,24 @@ def skew_eligible(program, fuse_steps: int) -> bool:
             return False
     r = ana.fused_step_radius().get(lead[-1], 0)
     return r > 0
+
+
+def skew_auto_engages(program, fuse_steps: int) -> bool:
+    """Would :func:`build_pallas_chunk` auto-engage the skewed wavefront
+    (``skew=None``, single device)?  Eligibility AND the profit gate:
+    skew computes (K+1)·r + E_sk extra stream-dim width per tile vs
+    2·K·r for uniform shrink — misaligned small radii lose to their own
+    E_sk widening.  THE shared definition for the build and the HBM
+    traffic model, so bench/stats describe the tiling actually run."""
+    if not skew_eligible(program, fuse_steps):
+        return False
+    from yask_tpu.compiler.lowering import tpu_tile_dims
+    ana = program.ana
+    lead = ana.domain_dims[:-1]
+    r = ana.fused_step_radius().get(lead[-1], 0)
+    sub_t, _ = tpu_tile_dims(program.dtype)
+    e_sk = 2 * sub_t if r % sub_t != 0 else 0
+    return (fuse_steps + 1) * r + e_sk < 2 * fuse_steps * r
 
 
 def default_vmem_budget(platform: str) -> int:
@@ -339,7 +358,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                        distributed: bool = False,
                        pipeline_dmas: Optional[bool] = None,
                        skew: Optional[bool] = None,
-                       vinstr_cap: int = 300_000):
+                       vinstr_cap: int = 300_000,
+                       stream_unsharded: bool = False):
     """Build ``chunk(state, t0) -> state`` advancing ``fuse_steps`` steps
     in one fused Pallas sweep.
 
@@ -429,22 +449,28 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     skew_ok = skew_eligible(program, K)
     R_s0 = rad.get(sdim, 0) if sdim else 0
     E_sk_c = 2 * sub_t if R_s0 % sub_t != 0 else 0
+    # Distributed chunks may skew only along an UNSHARDED stream dim
+    # (``stream_unsharded``, asserted by the shard planner): the carry
+    # strips then never cross a shard boundary, each shard spans the
+    # full stream extent, and the r·K ghost pads already cover the skew
+    # margins K·r (left) and r+E_sk (right, ≤ (K−1)·r whenever the
+    # profit gate engages).  This is the distributed temporal-blocking
+    # analog of the reference's rank-level wave-fronts (setup.cpp:863).
+    skew_dist_ok = not distributed or stream_unsharded
     use_skew = skew
     if use_skew is None:
-        # Auto-engage only when the skew margin model beats uniform
-        # shrink: skew computes (K+1)·r + E_sk extra stream-dim width
-        # per tile vs 2·K·r for uniform.  Misaligned small radii lose
-        # to their own E_sk widening (r=1 K=4: 21 vs 8 — the round-4
-        # cube-wavefront proxy regression); explicit skew=True still
-        # forces the path for A/B measurement.
-        use_skew = (skew_ok and not distributed
-                    and (K + 1) * R_s0 + E_sk_c < 2 * K * R_s0)
-    elif use_skew and (not skew_ok or distributed):
+        # Auto-engage per the shared skew_auto_engages definition (the
+        # r4 cube-wavefront proxy regression came from engaging
+        # unprofitable misaligned small radii); explicit skew=True
+        # still forces the path for A/B measurement.
+        use_skew = skew_dist_ok and skew_auto_engages(program, K)
+    elif use_skew and (not skew_ok or not skew_dist_ok):
         raise YaskException(
-            f"skewed wavefront needs K >= 2, a single-device chunk "
-            f"(distributed ghosts are only radius×K wide), a stream-dim "
+            f"skewed wavefront needs K >= 2, an unsharded stream dim "
+            f"(carry strips cannot cross shard boundaries), a stream-dim "
             f"radius > 0, and all written vars spanning every domain "
             f"dim; got K={K}, distributed={distributed}, "
+            f"stream_unsharded={stream_unsharded}, "
             f"radius={rad.get(sdim, 0) if sdim else 0}, partial-written="
             f"{sorted(g.name for g in program.geoms.values() if g.is_written and not g.is_scratch and g.domain_dims != dims)}")
     R_s = R_s0
@@ -484,8 +510,19 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     explicit_block = block is not None
     if block is None:
         from yask_tpu.ops.tile_planner import plan_blocks
+        smin = None
+        if use_skew:
+            # the carry save-strips must come from the tile's own valid
+            # region: stream blocks below (ring+1)·r would silently
+            # forfeit the skew, so floor the planner there
+            cv_d = max((len(program_state_slots(program, n))
+                        for n, g in program.geoms.items()
+                        if g.is_written and not g.is_scratch
+                        and n in ring_read_vars), default=0)
+            if cv_d:
+                smin = {sdim: (cv_d + 1) * R_s0}
         block = plan_blocks(program, fuse_steps=K, vmem_budget=vmem_budget,
-                            vinstr_cap=vinstr_cap)
+                            vinstr_cap=vinstr_cap, min_block=smin)
     else:
         block = {d: min(b, sizes[d]) for d, b in zip(lead, block)}
 
@@ -570,7 +607,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 program, fuse_steps=fuse_steps, block=block_arg,
                 interpret=interpret, vmem_budget=vmem_budget,
                 distributed=distributed, pipeline_dmas=pipeline_dmas,
-                skew=False, vinstr_cap=vinstr_cap)
+                skew=False, vinstr_cap=vinstr_cap,
+                stream_unsharded=stream_unsharded)
         raise
 
     var_order = [n for n in sorted(program.geoms)
@@ -692,7 +730,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 program, fuse_steps=fuse_steps, block=block_arg,
                 interpret=interpret, vmem_budget=vmem_budget,
                 distributed=distributed, pipeline_dmas=pipeline_dmas,
-                skew=False, vinstr_cap=vinstr_cap)
+                skew=False, vinstr_cap=vinstr_cap,
+                stream_unsharded=stream_unsharded)
 
     tile_bytes = in_tile_bytes + work_bytes
     if tile_bytes > vmem_budget:
@@ -722,6 +761,23 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 f"pallas pipelined tiles need {tile_bytes/2**20:.1f} MiB "
                 f"VMEM (budget {vmem_budget/2**20:.0f}); shrink block or "
                 "fuse_steps, or disable pipeline_dmas")
+    # Pipelined WRITE-back: output DMAs source DEDICATED parity-doubled
+    # staging tiles (not the consumed input scratch), so they stay in
+    # flight through the whole next grid step's compute — the input
+    # prefetch never touches them and each store retires two steps
+    # later, just before its parity's staging is re-filled.  Staging
+    # through the input scratch cannot overlap anything: the li+1
+    # prefetch targets the same parity the li−1 stores source, forcing
+    # retirement at the body top with zero instructions since the
+    # start.  Costs 2× an output-tile set; auto-disabled when that
+    # busts the budget (outputs then stage through the input scratch
+    # and drain at the end of each grid step).
+    ostage_bytes = 2 * sum(int(math.prod(tile_shape(n))) * esize
+                           * min(K, slots[n]) for n in written)
+    use_pipe_out = use_pipe and (2 * in_tile_bytes + work_bytes
+                                 + ostage_bytes <= vmem_budget)
+    if use_pipe_out:
+        tile_bytes += ostage_bytes
     minor_origin = {n: (g.pads[minor][0]
                         if minor in g.domain_dims else 0)
                     for n, g in program.geoms.items()}
@@ -758,11 +814,85 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         outs = refs[n_inputs:n_inputs + nout]
         n_tiles = sum(slots[n] for n in dma_vars)
         scratch = refs[n_inputs + nout:n_inputs + nout + n_tiles]
-        carr = refs[n_inputs + nout + n_tiles:-2]
+        _cb = n_inputs + nout + n_tiles
+        carr = refs[_cb:_cb + len(carry_vars)]
+        ostage = refs[_cb + len(carry_vars):-2]
         sem = refs[-2]
         out_sem = refs[-1]
 
         pid = [pl.program_id(i) for i in range(len(lead))]
+
+        def _coords(step):
+            """Decode a linear sequential-grid index into per-dim
+            coordinates (shared by the prefetch / retire / drain
+            paths)."""
+            cs = []
+            rem_ = step
+            for i in range(len(lead) - 1, -1, -1):
+                cs.append(rem_ % grid[i])
+                rem_ = rem_ // grid[i]
+            return cs[::-1]
+
+        def out_dmas(coords, par):
+            """The full set of output copies for grid position ``coords``
+            and staging parity ``par`` — reconstructed identically to
+            start and to wait (the wait may happen one grid step later,
+            see the pipelined retirement below)."""
+            cps = []
+            oi = 0
+            for name in written:
+                g = program.geoms[name]
+                nback = min(K, slots[name])
+                for s in range(nback):
+                    lvl = K - nback + s + 1   # time level this slot holds
+                    if use_pipe_out:
+                        sref = ostage[oi].at[par]
+                        osem = out_sem.at[par, oi]
+                    elif use_pipe:
+                        sref = scratch[si_base[name] + s].at[par]
+                        osem = out_sem.at[oi]
+                    else:
+                        sref = scratch[si_base[name] + s]
+                        osem = out_sem.at[oi]
+                    src_idxs = []
+                    dst_idxs = []
+                    for dn, kind in g.axes:
+                        if kind == "misc" or dn == minor:
+                            src_idxs.append(slice(None))
+                            dst_idxs.append(slice(None))
+                        elif use_skew and dn == sdim:
+                            # level lvl's write region sits shifted left
+                            # by (lvl−1)·r.  Sublane-multiple shifts
+                            # express exactly; others round the shift
+                            # DOWN to the sublane tile and widen the
+                            # window by one tile: both ends stay inside
+                            # the level's valid span (E_sk budgeted it),
+                            # and the sub_t overlap with the next
+                            # sequential tile re-writes identical valid
+                            # values (src and dst starts share the same
+                            # residue, g.origin ≡ mL+resid (mod 8)).
+                            shift = (lvl - 1) * R_s
+                            sh_al = (shift // sub_t) * sub_t
+                            wsz = block[dn] + (sub_t if sh_al != shift
+                                               else 0)
+                            src_idxs.append(pl.ds(
+                                mL[dn] - sh_al + resid[name, dn], wsz))
+                            dst_idxs.append(pl.ds(
+                                g.origin[dn] - sh_al
+                                + coords[lead.index(dn)] * block[dn],
+                                wsz))
+                        else:
+                            di = lead.index(dn)
+                            src_idxs.append(pl.ds(
+                                mL[dn] + resid[name, dn], block[dn]))
+                            dst_idxs.append(pl.ds(
+                                g.origin[dn] + coords[di] * block[dn],
+                                block[dn]))
+                    cps.append(pltpu.make_async_copy(
+                        sref.at[tuple(src_idxs)],
+                        outs[oi].at[tuple(dst_idxs)], osem))
+                    oi += 1
+            return cps
 
         # 1) DMA halo tiles HBM → VMEM (double-buffered across grid
         #    steps when use_pipe: compute on buffer li%2 while the next
@@ -810,14 +940,21 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 for dma in in_dmas(pid, 0):
                     dma.start()
 
-            # decompose li+1 into grid coords for the prefetch
             nxt = li + 1
-            nxt_coords = []
-            rem_ = nxt
-            for i in range(len(lead) - 1, -1, -1):
-                nxt_coords.append(rem_ % grid[i])
-                rem_ = rem_ // grid[i]
-            nxt_coords = nxt_coords[::-1]
+            nxt_coords = _coords(nxt)
+
+            if use_pipe_out:
+                # Retire the li−2 output DMAs (same staging parity as
+                # this step, cur) before this step's staging re-fills
+                # it.  Those copies got a full grid step (li−1's
+                # compute) of flight time, so this wait is ~free —
+                # the store path no longer serializes the grid.
+                pp_coords = _coords(li - 2)
+
+                @pl.when(li >= 2)
+                def _retire_out():
+                    for cp in out_dmas(pp_coords, cur):
+                        cp.wait()
 
             @pl.when(nxt < total_steps)
             def _prefetch():
@@ -1163,60 +1300,40 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         #    slots — every tile DMA fetches halo margins from every slot,
         #    so an in-place interior write by one grid step would corrupt
         #    a later step's margin reads on real (aliasing) hardware.
-        out_copies = []
-        oi = 0
+
+        _oi = 0
         for name in written:
-            g = program.geoms[name]
             ring = tiles[name]
             nback = min(K, slots[name])
             for s in range(nback):
-                lvl = K - nback + s + 1   # time level this slot holds
-                src_val = ring[len(ring) - nback + s]
-                sref = buf_ref(si_base[name] + s)
-                sref[...] = src_val
-                src_idxs = []
-                dst_idxs = []
-                for dn, kind in g.axes:
-                    if kind == "misc" or dn == minor:
-                        src_idxs.append(slice(None))
-                        dst_idxs.append(slice(None))
-                    elif use_skew and dn == sdim:
-                        # level lvl's write region sits shifted left by
-                        # (lvl−1)·r.  Sublane-multiple shifts express
-                        # exactly; others round the shift DOWN to the
-                        # sublane tile and widen the window by one tile:
-                        # both ends stay inside the level's valid span
-                        # (E_sk budgeted it), and the sub_t overlap with
-                        # the next sequential tile re-writes identical
-                        # valid values (src and dst starts share the
-                        # same residue, g.origin ≡ mL+resid (mod 8)).
-                        shift = (lvl - 1) * R_s
-                        sh_al = (shift // sub_t) * sub_t
-                        wsz = block[dn] + (sub_t if sh_al != shift
-                                           else 0)
-                        src_idxs.append(pl.ds(
-                            mL[dn] - sh_al + resid[name, dn], wsz))
-                        dst_idxs.append(pl.ds(
-                            g.origin[dn] - sh_al
-                            + pid[lead.index(dn)] * block[dn], wsz))
-                    else:
-                        di = lead.index(dn)
-                        src_idxs.append(pl.ds(mL[dn] + resid[name, dn],
-                                              block[dn]))
-                        dst_idxs.append(pl.ds(g.origin[dn]
-                                              + pid[di] * block[dn],
-                                              block[dn]))
-                cp = pltpu.make_async_copy(
-                    sref.at[tuple(src_idxs)],
-                    outs[oi].at[tuple(dst_idxs)],
-                    out_sem.at[oi])
-                cp.start()
-                out_copies.append(cp)
-                oi += 1
-        # all output DMAs must land before the next grid step re-fills
-        # the staging scratch tiles
-        for cp in out_copies:
-            cp.wait()
+                val = ring[len(ring) - nback + s]
+                if use_pipe_out:
+                    ostage[_oi].at[cur][...] = val
+                else:
+                    buf_ref(si_base[name] + s)[...] = val
+                _oi += 1
+        for cp in out_dmas(pid, cur):
+            cp.start()
+        if use_pipe_out:
+            # the copies stay in flight through the next grid step's
+            # compute (retired at step li+2's top, _retire_out); the
+            # final step drains the outstanding two parities so the
+            # kernel never ends with a DMA in flight
+            @pl.when(li == total_steps - 1)
+            def _drain_out():
+                # use_pipe_out implies total_steps > 1, so the final
+                # step always has a predecessor whose copies are the
+                # other outstanding parity
+                prv_coords = _coords(li - 1)
+                for cp in out_dmas(prv_coords, (li - 1) % 2):
+                    cp.wait()
+                for cp in out_dmas(pid, cur):
+                    cp.wait()
+        else:
+            # staging rides the consumed input scratch: the copies must
+            # land before the next grid step re-fills those tiles
+            for cp in out_dmas(pid, cur):
+                cp.wait()
 
     # ---- pallas_call assembly -------------------------------------------
 
@@ -1246,10 +1363,18 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # skewed-wavefront carry strips persist across the sequential grid
     for n in carry_vars:
         scratch_shapes.append(pltpu.VMEM(carry_shape(n), dtype))
+    # dedicated parity-doubled output staging (pipelined write-back)
+    if use_pipe_out:
+        for name in written:
+            for _ in range(min(K, slots[name])):
+                scratch_shapes.append(
+                    pltpu.VMEM((2,) + tile_shape(name), dtype))
     n_arrays = sum(slots[n] for n in dma_vars)
     scratch_shapes.append(pltpu.SemaphoreType.DMA(
         (2, n_arrays) if use_pipe else (n_arrays,)))
-    scratch_shapes.append(pltpu.SemaphoreType.DMA((max(nout_total, 1),)))
+    scratch_shapes.append(pltpu.SemaphoreType.DMA(
+        (2, max(nout_total, 1)) if use_pipe_out
+        else (max(nout_total, 1),)))
 
     kwargs = {}
     if not interpret:
@@ -1320,10 +1445,33 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
 
     # Report the tiling ACTUALLY chosen (skew/pipelining can auto-fall
     # back during planning) so stats/bench model the kernel that runs,
-    # not the one eligibility predicted (ADVICE r3).
+    # not the one eligibility predicted (ADVICE r3).  margin_overhead =
+    # redundant computed volume / useful volume per K-group, from the
+    # exact per-(sub-step, stage) region widths — the number the skew
+    # tiling exists to shrink (reference reports the analogous
+    # wave-front overlap in its temporal-tiling stats).
+    _useful = _computed = 0
+    for _k in range(K):
+        _cons = {d: rad[d] * _k for d in lead}
+        for _si in range(nstages):
+            for d in lead:
+                _cons[d] += stage_r[_si][d]
+            _v = _u = 1
+            for d in lead:
+                if use_skew and d == sdim:
+                    _cst = _cons[d] - rad[d] * _k
+                    _v *= block[d] + 2 * (R_s - _cst) + E_sk
+                else:
+                    _v *= block[d] + mL[d] + mR[d] - 2 * _cons[d]
+                _u *= block[d]
+            _computed += _v
+            _useful += _u
     chunk.tiling = {"fuse_steps": K, "block": dict(block),
                     "skew": bool(use_skew), "pipeline_dmas": use_pipe,
-                    "tile_bytes": tile_bytes}
+                    "pipeline_out": use_pipe_out,
+                    "tile_bytes": tile_bytes,
+                    "margin_overhead":
+                        round(_computed / max(_useful, 1) - 1, 4)}
     return chunk, tile_bytes
 
 

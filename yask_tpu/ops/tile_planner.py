@@ -30,7 +30,9 @@ def sublane_count(dtype) -> int:
 
 def plan_blocks(program, fuse_steps: int = 1,
                 vmem_budget: int = 100 * 2 ** 20,
-                vinstr_cap: int = 300_000) -> Dict[str, int]:
+                vinstr_cap: int = 300_000,
+                min_block: Optional[Dict[str, int]] = None
+                ) -> Dict[str, int]:
     """Choose leading-dim block sizes for the Pallas path.
 
     ``vinstr_cap`` bounds the estimated Mosaic vector-instruction count
@@ -67,6 +69,7 @@ def plan_blocks(program, fuse_steps: int = 1,
         while sizes[d] % b != 0:
             b -= 1
         block[d] = max(b, 1)
+
 
     # estimate VMEM need and grow blocks while they fit (bigger tiles
     # amortize halo overlap)
@@ -124,6 +127,22 @@ def plan_blocks(program, fuse_steps: int = 1,
             per *= blk[d] + 2 * hK[d]
         vregs = per * minor_ext / (sub * 128)
         return num_ops * fuse_steps * vregs
+
+    # per-dim floors (the skew carry needs stream blocks ≥ (ring+1)·r —
+    # without this the default plan silently forfeits the skewed
+    # tiling).  The floor must not bypass the vinstr compile-time
+    # guard: if the floored plan busts the cap, leave the dim alone and
+    # let the build fall back to the uniform tiling.
+    for d, mn in (min_block or {}).items():
+        if d in block and block[d] < mn:
+            b = min(mn, sizes[d])
+            while sizes[d] % b != 0 and b < sizes[d]:
+                b += 1
+            cand = dict(block)
+            cand[d] = b
+            if not (vinstr_cap and num_ops
+                    and vinstr(cand) > vinstr_cap):
+                block[d] = b
 
     def overhead(blk):
         """Read-reuse model: fraction of each tile's loads + compute that

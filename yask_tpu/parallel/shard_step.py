@@ -663,19 +663,35 @@ def _prep_shard_pallas(ctx, n: int, K: int, blk):
     groups, rem = divmod(n, K)
     interp = ctx._env.get_platform() != "tpu"
     budget = ctx.vmem_budget()
+    # Temporal blocking across shards: the skewed wavefront may engage
+    # inside each shard when the stream dim is NOT mesh-decomposed —
+    # the carry then never crosses a shard boundary and the r·K ghost
+    # pads cover the skew margins, so the distributed path stops paying
+    # the uniform 2·r·K recompute margin in that dim (the rank-level
+    # temporal-tiling analog of the reference's update_tb_info,
+    # setup.cpp:863).
+    lead_local = dims[:-1]
+    sdim = lead_local[-1] if lead_local else None
+    stream_unsharded = sdim is not None and nr.get(sdim, 1) == 1
+    skw = None if ctx._opts.skew_wavefront else False
     chunk, tile_bytes = build_pallas_chunk(
         local_prog, fuse_steps=K, block=blk, interpret=interp,
         distributed=True, vmem_budget=budget,
-        vinstr_cap=ctx._opts.max_tile_vinstr)
+        vinstr_cap=ctx._opts.max_tile_vinstr, skew=skw,
+        stream_unsharded=stream_unsharded)
     chunk_rem = None
     if rem:
         chunk_rem, _ = build_pallas_chunk(
             local_prog, fuse_steps=rem, block=blk, interpret=interp,
             distributed=True, vmem_budget=budget,
-            vinstr_cap=ctx._opts.max_tile_vinstr)
+            vinstr_cap=ctx._opts.max_tile_vinstr, skew=skw,
+            stream_unsharded=stream_unsharded)
+    ctx._pallas_tiling[("shard_pallas", K, blk)] = chunk.tiling
     ctx._env.trace_msg(
         f"shard_pallas chunk: K={K}, blocks={blk or 'planner'}, "
-        f"tile {tile_bytes / 2**20:.2f} MiB")
+        f"tile {tile_bytes / 2**20:.2f} MiB, "
+        f"skew={chunk.tiling['skew']}, "
+        f"margin_overhead={chunk.tiling['margin_overhead']}")
 
     def build(exchange):
         """shard_map program with the given exchange implementation —

@@ -146,6 +146,12 @@ class StencilContext:
     def get_block_size(self, dim: str) -> int:
         return self._opts.block_sizes[dim]
 
+    def get_element_bytes(self) -> int:
+        """Bytes per FP element (reference ``yk_solution::get_element_bytes``,
+        driven by ``swe_main.cpp:398``)."""
+        import numpy as np
+        return int(np.dtype(self._csol.dtype).itemsize)
+
     def set_num_ranks(self, dim: str, n: int) -> None:
         self._opts.num_ranks[dim] = n
 
@@ -928,14 +934,31 @@ class StencilContext:
             if self._opts.mode == "pallas":
                 key, _blk, _skw = self._pallas_build_key(K)
                 built = self._pallas_tiling.get(key)
+            else:
+                # shard_pallas records its inner chunk's tiling under
+                # ("shard_pallas", K, blk) — distributed skew can now
+                # engage (stream dim unsharded), so the model must use
+                # what actually ran, not assume uniform margins.  Key
+                # on the exact (K, blk) the run path derives, or an
+                # auto-tune walk's other variants could shadow it.
+                bs = self._opts.block_sizes
+                sblk = None
+                if any(bs[d] > 0 for d in self._ana.domain_dims[:-1]):
+                    sblk = tuple(bs[d] if bs[d] > 0 else 8
+                                 for d in self._ana.domain_dims[:-1])
+                built = self._pallas_tiling.get(
+                    ("shard_pallas", K, sblk))
             if built is not None:
                 return self._program.hbm_bytes_per_point(
                     fuse_steps=K, block=built["block"],
                     skew=built["skew"])
-            from yask_tpu.ops.pallas_stencil import skew_eligible
-            skw = (self._opts.mode == "pallas"
-                   and self._opts.skew_wavefront
-                   and skew_eligible(self._program, K))
+            from yask_tpu.ops.pallas_stencil import skew_auto_engages
+            skw = (self._opts.skew_wavefront
+                   and skew_auto_engages(self._program, K))
+            if skw and self._opts.mode == "shard_pallas":
+                # distributed skew needs the stream dim unsharded
+                lead = self._ana.domain_dims[:-1]
+                skw = bool(lead) and self._opts.num_ranks[lead[-1]] <= 1
             return self._program.hbm_bytes_per_point(
                 fuse_steps=K, block=blk, skew=skw)
         return self._program.hbm_bytes_per_point()
