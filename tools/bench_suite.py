@@ -142,12 +142,22 @@ def run_suite(fac, env, budget_secs=None):
                 if g == (256 if on_tpu else 48):
                     raise
 
+    def _tiling_of(ctx):
+        """The tiling the built kernel ACTUALLY chose, for row
+        provenance (skew / pipelining can auto-fall-back)."""
+        for t in ctx._pallas_tiling.values():
+            if t:
+                return {k: t[k] for k in ("skew", "pipeline_dmas",
+                                          "pipeline_out",
+                                          "margin_overhead") if k in t}
+        return {}
+
     def iso3dfd_pallas():
         validated_pallas(fac, env, "iso3dfd", 8, wf=2)
         g = 512 if on_tpu else 48
         ctx = build(fac, env, "iso3dfd", 8, g, "pallas", wf=2)
         emit(f"iso3dfd r=8 {g}^3 {plat} pallas-K2",
-             measure(ctx, g ** 3, steps), "GPts/s")
+             measure(ctx, g ** 3, steps), "GPts/s", **_tiling_of(ctx))
         del ctx
 
     def cube_wavefront():
@@ -158,10 +168,16 @@ def run_suite(fac, env, budget_secs=None):
         del c1
         c4 = build(fac, env, "cube", 1, gc, "pallas", wf=4)
         fused = measure(c4, gc ** 3, steps)
-        del c4
+        speedup = fused / max(base, 1e-12)
+        # regression guard (VERDICT r4 item 3): the r4 proxy silently
+        # halved when skew auto-engaged at r=1 — flag any future slide
+        # in the artifact itself (test_skew pins the structural cause)
         emit(f"cube 27pt {gc}^3 {plat} wavefront-speedup",
-             fused / max(base, 1e-12), "x", k1_gpts=round(base, 4),
-             k4_gpts=round(fused, 4))
+             speedup, "x", k1_gpts=round(base, 4),
+             k4_gpts=round(fused, 4), **_tiling_of(c4),
+             **({"regression": f"speedup {speedup:.2f} < 1.5 floor"}
+                if speedup < 1.5 else {}))
+        del c4
 
     def ssg_elastic():
         gs = 256 if on_tpu else 32

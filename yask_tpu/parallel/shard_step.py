@@ -686,7 +686,6 @@ def _prep_shard_pallas(ctx, n: int, K: int, blk):
             distributed=True, vmem_budget=budget,
             vinstr_cap=ctx._opts.max_tile_vinstr, skew=skw,
             stream_unsharded=stream_unsharded)
-    ctx._pallas_tiling[("shard_pallas", K, blk)] = chunk.tiling
     ctx._env.trace_msg(
         f"shard_pallas chunk: K={K}, blocks={blk or 'planner'}, "
         f"tile {tile_bytes / 2**20:.2f} MiB, "
@@ -795,6 +794,11 @@ def _prep_shard_pallas(ctx, n: int, K: int, blk):
             return shard_map(body, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_rep=False)
 
+    # carried to get_shard_pallas_fn, which records it into
+    # ctx._pallas_tiling only AFTER a successful Mosaic compile (a
+    # failure must not leave stats modeling a tiling that never ran —
+    # same invariant as the single-device path, context.py)
+    build.tiling = chunk.tiling
     return names, specs_for, build
 
 
@@ -812,7 +816,8 @@ def get_shard_pallas_fn(ctx, interior, start: int, n: int, K: int, blk,
     ``YaskException`` for infeasible candidates."""
     import jax
     import jax.numpy as jnp
-    key = ("shard_pallas", n, K, blk)
+    skw = None if ctx._opts.skew_wavefront else False
+    key = ("shard_pallas", n, K, blk, skw)
     if key not in ctx._jit_cache:
         if build is None:
             _, _, build = _prep_shard_pallas(ctx, n, K, blk)
@@ -821,6 +826,10 @@ def get_shard_pallas_fn(ctx, interior, start: int, n: int, K: int, blk,
             jax.jit(build(exchange_ghosts), donate_argnums=0) \
             .lower(interior, jnp.asarray(start, dtype=jnp.int32)).compile()
         ctx._compile_secs += time.perf_counter() - t0c
+        # only after a successful compile (see _prep_shard_pallas)
+        if getattr(build, "tiling", None) is not None:
+            ctx._pallas_tiling[("shard_pallas", K, blk, skw)] = \
+                build.tiling
     return ctx._jit_cache[key]
 
 
@@ -859,7 +868,8 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
     blk = None
     if any(bs[d] > 0 for d in dims[:-1]):
         blk = tuple(bs[d] if bs[d] > 0 else 8 for d in dims[:-1])
-    key = ("shard_pallas", n, K, blk)
+    key = ("shard_pallas", n, K, blk,
+           None if opts.skew_wavefront else False)
 
     need_build = key not in ctx._jit_cache
     need_cal = (opts.measure_halo_time and key not in ctx._halo_frac)

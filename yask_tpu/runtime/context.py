@@ -149,7 +149,6 @@ class StencilContext:
     def get_element_bytes(self) -> int:
         """Bytes per FP element (reference ``yk_solution::get_element_bytes``,
         driven by ``swe_main.cpp:398``)."""
-        import numpy as np
         return int(np.dtype(self._csol.dtype).itemsize)
 
     def set_num_ranks(self, dim: str, n: int) -> None:
@@ -946,8 +945,9 @@ class StencilContext:
                 if any(bs[d] > 0 for d in self._ana.domain_dims[:-1]):
                     sblk = tuple(bs[d] if bs[d] > 0 else 8
                                  for d in self._ana.domain_dims[:-1])
+                sskw = None if self._opts.skew_wavefront else False
                 built = self._pallas_tiling.get(
-                    ("shard_pallas", K, sblk))
+                    ("shard_pallas", K, sblk, sskw))
             if built is not None:
                 return self._program.hbm_bytes_per_point(
                     fuse_steps=K, block=built["block"],
