@@ -326,27 +326,69 @@ class yk_var:
         self._ctx._materialize_state()  # sync from resident shard state
         return self._ctx._state[self._name]
 
-    def _slot_for_step(self, t: Optional[int]) -> int:
+    def _slot_idx(self, t: Optional[int], nslots: int) -> int:
         """Map an absolute step index to a ring slot (the reference's
-        step-index wrapping, ``yk_var.hpp:820-825``)."""
-        ring = self._ring()
+        step-index wrapping, ``yk_var.hpp:820-825``) given the ring
+        length — shared by the padded-state and device-resident paths."""
         g = self._geom()
         if not (g.has_step and g.is_written):
             return 0
         cur = self._ctx._cur_step
         if t is None:
-            return len(ring) - 1
+            return nslots - 1
         d = (cur - t) * self._ctx._csol.ana.step_dir
-        slot = len(ring) - 1 - d
-        if not (0 <= slot < len(ring)):
+        slot = nslots - 1 - d
+        if not (0 <= slot < nslots):
             if self._ctx.get_step_wrap():
                 # yk_solution::set_step_wrap(true): any step index is
                 # valid and wraps onto the ring (yk_var_api.hpp:95)
-                return slot % len(ring)
+                return slot % nslots
             raise YaskException(
                 f"step {t} of var '{self._name}' not in allocation "
-                f"(current step {cur}, {len(ring)} slot(s))")
+                f"(current step {cur}, {nslots} slot(s))")
         return slot
+
+    def _slot_for_step(self, t: Optional[int]) -> int:
+        return self._slot_idx(t, len(self._ring()))
+
+    def _resident_idx(self, indices: Sequence[int]):
+        """(slot, physical index) onto the device-resident stripped
+        interiors, or None when state is not resident, any domain index
+        addresses a pad, or anything else needs the strict padded path.
+
+        The reference keeps mid-run element writes cheap with per-var
+        dirty flags (``yk_var.hpp:564``); here shard-mode state lives
+        device-resident between runs and every run re-pads + exchanges
+        from the interiors, so an in-place device update is always
+        consistent — the escape hatch that avoids a full
+        materialize/re-pad round trip per element access."""
+        ctx = self._ctx
+        if ctx._resident is None or self._name not in ctx._resident:
+            return None
+        v = self._var()
+        g = self._geom()
+        if len(indices) != len(v.get_dims()):
+            return None   # strict path raises the right error
+        t = None
+        by_dim = {}
+        for d, i in zip(v.get_dims(), indices):
+            if d.type.value == "step":
+                t = int(i)
+                continue
+            if d.type.value == "domain":
+                idx = int(i) - ctx._rank_offset.get(d.name, 0)
+                size = ctx._opts.global_domain_sizes[d.name]
+                if not (0 <= idx < size):
+                    return None   # pad access: strict path handles it
+            else:
+                idx = int(i) - g.misc_lo[d.name]
+                if not (0 <= idx < g.misc_ext[d.name]):
+                    return None
+            by_dim[d.name] = idx
+        ring = ctx._resident[self._name]
+        slot = self._slot_idx(t, len(ring))
+        rest = tuple(by_dim[n] for n, _k in g.axes)
+        return slot, rest
 
     def _split_indices(self, indices: Sequence[int]) -> Tuple[Optional[int], List]:
         """Split full-index list (declared dim order) into (step, rest),
@@ -390,12 +432,24 @@ class yk_var:
     # -- element access (yk_var_api.hpp:700-951) ---------------------------
 
     def get_element(self, indices: Sequence[int]) -> float:
+        ri = self._resident_idx(indices)
+        if ri is not None:
+            slot, rest = ri
+            return float(self._ctx._resident[self._name][slot][rest])
         t, rest = self._split_indices(indices)
         arr = np.asarray(self._ring()[self._slot_for_step(t)])
         return float(arr[tuple(rest)])
 
     def set_element(self, val: float, indices: Sequence[int],
                     strict_indices: bool = True) -> int:
+        ri = self._resident_idx(indices)
+        if ri is not None:
+            slot, rest = ri
+            ring = list(self._ctx._resident[self._name])
+            ring[slot] = ring[slot].at[rest].set(val)
+            self._ctx._resident[self._name] = ring
+            self._dirty = True
+            return 1
         t, rest = self._split_indices(indices)
         slot = self._slot_for_step(t)
         self._ctx._update_state_array(
@@ -404,6 +458,14 @@ class yk_var:
         return 1
 
     def add_to_element(self, val: float, indices: Sequence[int]) -> int:
+        ri = self._resident_idx(indices)
+        if ri is not None:
+            slot, rest = ri
+            ring = list(self._ctx._resident[self._name])
+            ring[slot] = ring[slot].at[rest].add(val)
+            self._ctx._resident[self._name] = ring
+            self._dirty = True
+            return 1
         t, rest = self._split_indices(indices)
         slot = self._slot_for_step(t)
         self._ctx._update_state_array(
