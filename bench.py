@@ -119,7 +119,7 @@ def _last_tpu_result():
     newest per-chunk microbench when no end-to-end record exists —
     chunk timings exclude host/trial overhead and are not directly
     comparable). Never fatal."""
-    newest = newest_chunk = None
+    newest = newest_chunk = newest_iso_chunk = None
     try:
         with open(_TPU_RESULTS) as f:
             for ln in f:
@@ -127,13 +127,16 @@ def _last_tpu_result():
                 if not ln:
                     continue
                 rec = json.loads(ln)
-                if " chunk " in rec.get("metric", ""):
+                m = rec.get("metric", "")
+                if " chunk " in m:
                     newest_chunk = rec   # file order == time order
+                    if "iso3dfd" in m:   # flagship over A/B side stencils
+                        newest_iso_chunk = rec
                 else:
                     newest = rec
     except Exception:
         pass
-    return newest or newest_chunk
+    return newest or newest_iso_chunk or newest_chunk
 
 
 def build(fac, env, g, mode="jit", wf=0, radius=8):

@@ -159,7 +159,8 @@ def main(argv=None) -> int:
     from yask_tpu.ops.pallas_stencil import default_vmem_budget
     budget = default_vmem_budget(plat)
 
-    def time_chunk(tag, prog_=None, state_=None, metric=None, **kw):
+    def time_chunk(tag, prog_=None, state_=None, metric=None,
+                   npts=None, **kw):
         """Time one chunk variant; returns its one-chunk output state
         (or None on failure) so A/B stages can cross-validate.  The
         default (prog, state) pair is the fp32 flagship; the bf16 stage
@@ -181,7 +182,7 @@ def main(argv=None) -> int:
             jax.block_until_ready(st)
             dt = (time.perf_counter() - t0) / 5
             k = kw.get("fuse_steps", 1)
-            gpts = round(gi ** 3 * k / dt / 1e9, 2)
+            gpts = round((npts or gi ** 3) * k / dt / 1e9, 2)
             log(tag, **{k2: v for k2, v in kw.items()},
                 tile_mib=round(tb / 2**20, 2),
                 secs_per_chunk=round(dt, 5), gpts=gpts)
@@ -222,6 +223,30 @@ def main(argv=None) -> int:
         if uni is not None and skw is not None:
             log("skew_ab", fuse_steps=k,
                 max_abs_diff=float(max_abs_diff(uni, skw)))
+
+    # 3a2) misaligned-radius skew (E_sk window widening, r % sublane
+    #      != 0): the sublane-rounded write windows + widened regions
+    #      have only ever run in interpret mode — force skew on a
+    #      cube r=1 K=4 chunk and bit-compare against uniform.
+    try:
+        gq = min(gi, 128)
+        progc = create_solution("cube", radius=1).get_soln().compile() \
+            .plan(IdxTuple(x=gq, y=gq, z=gq),
+                  extra_pad={"x": (32, 32), "y": (32, 32), "z": (0, 0)})
+        statec = progc.alloc_state(init=seeded_init(progc))
+        uni_c = time_chunk(
+            "esk_ab", prog_=progc, state_=statec, npts=gq ** 3,
+            metric=f"cube r=1 {gq}^3 tpu pallas chunk (esk_ab uniform)",
+            fuse_steps=4, skew=False)
+        skw_c = time_chunk(
+            "esk_ab", prog_=progc, state_=statec, npts=gq ** 3,
+            metric=f"cube r=1 {gq}^3 tpu pallas chunk (esk_ab skew)",
+            fuse_steps=4, skew=True)
+        if uni_c is not None and skw_c is not None:
+            log("esk_ab", fuse_steps=4,
+                max_abs_diff=float(max_abs_diff(uni_c, skw_c)))
+    except Exception as e:  # noqa: BLE001
+        log("esk_ab", error=str(e)[:300])
 
     # 3b) bf16 A/B: the half-traffic roofline lever.  The CPU proxy
     #     inverts (bf16 is software-emulated off-TPU) so only this
