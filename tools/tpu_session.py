@@ -10,11 +10,17 @@ a completed joint tune, and a tuned bench number (VERDICT r3 items
 
 1. smoke: iso3dfd on the XLA path (device sanity);
 2. validate: the pallas equivalence matrix ON DEVICE (interpret=False,
-   real Mosaic lowering) against the jit path;
-3. A/B: pipeline_dmas on/off on a multi-block grid (bit-equality +
-   timing);
+   real Mosaic lowering) against the jit path — runs FIRST on full
+   sessions, but AFTER the perf stages on ``--quick`` first-window
+   sessions (round 3 lost its hardware numbers to a relay drop while
+   validation compiles were still grinding);
+3. A/B: pipeline_dmas / skew / misaligned-E_sk / bf16 chunk variants
+   (bit-equality cross-checks + timing on real DMA engines);
 4. tune: joint (K, block) auto-tuner walk on iso3dfd at the bench size;
-5. report: a BENCH-style JSON line per stage.
+5. report: a BENCH-style JSON line per stage (each perf row is
+   persisted to TPU_RESULTS.jsonl the moment it is measured); then
+6. compile-time A/B of the ``max_vinstr`` tile cap on ssg/swe2d.
+Every stage is crash-isolated from the rest.
 
 Run: ``python tools/tpu_session.py [-g 512] [--quick]``
 (needs the real backend: do NOT set JAX_PLATFORMS=cpu).
@@ -96,223 +102,254 @@ def main(argv=None) -> int:
     ctx.run_solution(0, 4)
     log("smoke", ok=True)
 
-    # 2) on-device pallas validation matrix
-    failures = []
-    cases = MATRIX[:4] if quick else MATRIX
-    for name, radius in cases:
-        try:
-            ref = build(fac, env, name, "jit", 32, radius)
-            ref.run_solution(0, 3)
-            for wf in (1, 2):
-                p = build(fac, env, name, "pallas", 32, radius, wf=wf)
-                p.run_solution(0, 3)
-                bad = p.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4)
-                log("validate", stencil=name, K=wf, mismatches=int(bad))
-                if bad:
-                    failures.append((name, wf, int(bad)))
-        except Exception as e:
-            log("validate", stencil=name, error=str(e)[:200])
-            failures.append((name, "error", str(e)[:80]))
-    if failures:
-        log("validate", summary="FAILURES", detail=failures)
-    else:
-        log("validate", summary="all pallas cases match jit on device")
+    def run_matrix():
+        # on-device pallas validation matrix
+        failures = []
+        cases = MATRIX[:4] if quick else MATRIX
+        for name, radius in cases:
+            try:
+                ref = build(fac, env, name, "jit", 32, radius)
+                ref.run_solution(0, 3)
+                for wf in (1, 2):
+                    p = build(fac, env, name, "pallas", 32, radius,
+                              wf=wf)
+                    p.run_solution(0, 3)
+                    bad = p.compare_data(ref, epsilon=1e-3,
+                                         abs_epsilon=1e-4)
+                    log("validate", stencil=name, K=wf,
+                        mismatches=int(bad))
+                    if bad:
+                        failures.append((name, wf, int(bad)))
+            except Exception as e:
+                log("validate", stencil=name, error=str(e)[:200])
+                failures.append((name, "error", str(e)[:80]))
+        if failures:
+            log("validate", summary="FAILURES", detail=failures)
+        else:
+            log("validate", summary="all pallas cases match jit on "
+                "device")
 
-    # 3) pipeline + skew A/Bs (timing on real DMA engines).  Each stage
-    #    is isolated: a Mosaic failure in one A/B must not cost the rest
-    #    of the session (the relay window may be short).
-    from yask_tpu.ops.pallas_stencil import build_pallas_chunk
-    from yask_tpu.utils.idx_tuple import IdxTuple
-    from yask_tpu.compiler.solution_base import create_solution
-    import jax
-    gi = min(g_bench, 256)
-    prog = create_solution("iso3dfd", radius=8).get_soln().compile().plan(
-        IdxTuple(x=gi, y=gi, z=gi),
-        extra_pad={"x": (32, 32), "y": (32, 32), "z": (0, 0)})
+    # 2) validation matrix ordering: on a --quick (first-window)
+    #    session the PERF stages run first — round 3 lost its hardware
+    #    numbers because the relay dropped while validation compiles
+    #    were still grinding; the A/B cross-checks below give internal
+    #    consistency and the matrix still runs afterwards if the window
+    #    holds.  Full sessions validate first (VERDICT r4 item 4).
+    if not quick:
+        run_matrix()
 
-    # Seed INTERIORS (pads must stay zero — the ghost-zero invariant):
-    # a zero state would make every A/B cross-check vacuous, since
-    # iso3dfd is linear homogeneous and zero stays zero.
-    def seeded_init(prog_=None):
-        prog_ = prog_ or prog
-        rng = np.random.RandomState(7)
-        init = {}
-        for name, g in prog_.geoms.items():
-            if g.is_scratch:
-                continue
-            a = np.zeros(tuple(g.shape), np.float32)
-            idx = tuple(
-                slice(g.origin[dn], g.origin[dn] + prog_.sizes[dn])
-                if kind == "domain" else slice(None)
-                for dn, kind in g.axes)
-            shape = a[idx].shape
-            if name == "vel":
-                a[idx] = 0.0005 + rng.rand(*shape).astype(np.float32) \
-                    * 0.0005
-            else:
-                a[idx] = (rng.rand(*shape).astype(np.float32) - 0.5) * 0.1
-            init[name] = np.asarray(a, dtype=prog_.dtype)
-        return init
-
-    state = prog.alloc_state(init=seeded_init())
-    interp = plat != "tpu"   # only under YT_TPU_SESSION_FORCE
-    from yask_tpu.ops.pallas_stencil import default_vmem_budget
-    budget = default_vmem_budget(plat)
-
-    def time_chunk(tag, prog_=None, state_=None, metric=None,
-                   npts=None, **kw):
-        """Time one chunk variant; returns its one-chunk output state
-        (or None on failure) so A/B stages can cross-validate.  The
-        default (prog, state) pair is the fp32 flagship; the bf16 stage
-        passes its own so the timing/recording protocol stays single-
-        definition."""
-        prog_ = prog_ or prog
-        state_ = state_ if state_ is not None else state
-        try:
-            chunk, tb = build_pallas_chunk(prog_, interpret=interp,
-                                           vmem_budget=budget, **kw)
-            fn = chunk if interp else \
-                jax.jit(chunk).lower(state_, 0).compile()
-            st1 = fn(state_, 0)
-            jax.block_until_ready(st1)
-            st = st1
-            t0 = time.perf_counter()
-            for _ in range(5):
-                st = fn(st, 0)
-            jax.block_until_ready(st)
-            dt = (time.perf_counter() - t0) / 5
-            k = kw.get("fuse_steps", 1)
-            gpts = round((npts or gi ** 3) * k / dt / 1e9, 2)
-            log(tag, **{k2: v for k2, v in kw.items()},
-                tile_mib=round(tb / 2**20, 2),
-                secs_per_chunk=round(dt, 5), gpts=gpts)
-            if plat == "tpu":
-                from bench import _record_tpu_result
-                _record_tpu_result({
-                    "metric": metric or (f"iso3dfd r=8 {gi}^3 fp32 tpu "
-                                         f"pallas chunk ({tag} {kw})"),
-                    "value": gpts, "unit": "GPts/s", "platform": plat,
-                    "vs_baseline": round(gpts / 500.0, 4)})
-            return st1
-        except Exception as e:  # noqa: BLE001
-            log(tag, error=str(e)[:300], **kw)
-            return None
-
-    def max_abs_diff(a, b):
-        m = 0.0
-        for n in a:
-            for x, y in zip(a[n], b[n]):
-                m = max(m, float(jax.numpy.max(jax.numpy.abs(x - y))))
-        return m
-
-    unpiped = time_chunk("pipeline_ab", fuse_steps=2,
-                         pipeline_dmas=False, skew=False)
-    piped = time_chunk("pipeline_ab", fuse_steps=2, pipeline_dmas=True,
-                       skew=False)
-    if unpiped is not None and piped is not None:
-        # bit-equality promised by the protocol: double-buffering must
-        # not change values (the aliasing hazard CLAUDE.md documents)
-        log("pipeline_ab", fuse_steps=2,
-            max_abs_diff=float(max_abs_diff(unpiped, piped)))
-    # skew A/B: uniform shrink vs streaming skewed wavefront, growing
-    # K; the two tilings must agree numerically on real Mosaic (first
-    # hardware execution of the carry machinery)
-    for k in (2, 4):
-        uni = time_chunk("skew_ab", fuse_steps=k, skew=False)
-        skw = time_chunk("skew_ab", fuse_steps=k, skew=True)
-        if uni is not None and skw is not None:
-            log("skew_ab", fuse_steps=k,
-                max_abs_diff=float(max_abs_diff(uni, skw)))
-
-    # 3a2) misaligned-radius skew (E_sk window widening, r % sublane
-    #      != 0): the sublane-rounded write windows + widened regions
-    #      have only ever run in interpret mode — force skew on a
-    #      cube r=1 K=4 chunk and bit-compare against uniform.
-    try:
-        gq = min(gi, 128)
-        progc = create_solution("cube", radius=1).get_soln().compile() \
-            .plan(IdxTuple(x=gq, y=gq, z=gq),
-                  extra_pad={"x": (32, 32), "y": (32, 32), "z": (0, 0)})
-        statec = progc.alloc_state(init=seeded_init(progc))
-        uni_c = time_chunk(
-            "esk_ab", prog_=progc, state_=statec, npts=gq ** 3,
-            metric=f"cube r=1 {gq}^3 tpu pallas chunk (esk_ab uniform)",
-            fuse_steps=4, skew=False)
-        skw_c = time_chunk(
-            "esk_ab", prog_=progc, state_=statec, npts=gq ** 3,
-            metric=f"cube r=1 {gq}^3 tpu pallas chunk (esk_ab skew)",
-            fuse_steps=4, skew=True)
-        if uni_c is not None and skw_c is not None:
-            log("esk_ab", fuse_steps=4,
-                max_abs_diff=float(max_abs_diff(uni_c, skw_c)))
-    except Exception as e:  # noqa: BLE001
-        log("esk_ab", error=str(e)[:300])
-
-    # 3b) bf16 A/B: the half-traffic roofline lever.  The CPU proxy
-    #     inverts (bf16 is software-emulated off-TPU) so only this
-    #     hardware row can confirm the >=1.5x target; sublane-16
-    #     geometry is exercised by the same chunk builder, and the
-    #     timing/recording protocol is time_chunk's single definition.
-    try:
-        from yask_tpu.compiler.solution_base import create_solution as _cs
-        sb16 = _cs("iso3dfd", radius=8)
-        sb16.get_soln().set_element_bytes(2)
-        prog16 = sb16.get_soln().compile().plan(
+    def perf_stages() -> int:
+        """Stages 3-5 (chunk A/Bs, joint tune, tuned bench).  Any
+        crash here — setup included — must not cost the deferred
+        validation matrix or the compile-time stage: the relay window
+        may still be healthy (round-3 failure mode)."""
+        # 3) pipeline + skew A/Bs (timing on real DMA engines).  Each stage
+        #    is isolated: a Mosaic failure in one A/B must not cost the rest
+        #    of the session (the relay window may be short).
+        from yask_tpu.ops.pallas_stencil import build_pallas_chunk
+        from yask_tpu.utils.idx_tuple import IdxTuple
+        from yask_tpu.compiler.solution_base import create_solution
+        import jax
+        gi = min(g_bench, 256)
+        prog = create_solution("iso3dfd", radius=8).get_soln().compile().plan(
             IdxTuple(x=gi, y=gi, z=gi),
             extra_pad={"x": (32, 32), "y": (32, 32), "z": (0, 0)})
-        state16 = prog16.alloc_state(init=seeded_init(prog16))
-        time_chunk("bf16_ab", prog_=prog16, state_=state16,
-                   metric=f"iso3dfd r=8 {gi}^3 bf16 tpu pallas chunk K2",
-                   fuse_steps=2)
-    except Exception as e:  # noqa: BLE001
-        log("bf16_ab", error=str(e)[:300])
 
-    # 4) joint auto-tune at the bench size.  tune_max_wf_steps stays
-    #    small: pads are planned for radius × the cap, so 16 would
-    #    inflate every state array (784^3 for 512^3 at r=8) and make
-    #    each candidate compile minutes long.
-    from yask_tpu.runtime.auto_tuner import AutoTuner
-    ctx = build(fac, env, "iso3dfd", "pallas", g_bench, 8, wf=2,
-                tune=True, tune_max=4)
-    ctx.get_settings().auto_tune_trial_secs = 0.5
-    try:
-        tuner = AutoTuner(ctx)
-        best_k = tuner.run_auto_tuner_now()
-        s = ctx.get_settings()
-        log("tune", wf_steps=best_k,
-            blocks={d: s.block_sizes[d] for d in ("x", "y")},
-            candidates=len(tuner.results))
-    except Exception as e:  # noqa: BLE001
-        log("tune", error=str(e)[:300])
+        # Seed INTERIORS (pads must stay zero — the ghost-zero invariant):
+        # a zero state would make every A/B cross-check vacuous, since
+        # iso3dfd is linear homogeneous and zero stays zero.
+        def seeded_init(prog_=None):
+            prog_ = prog_ or prog
+            rng = np.random.RandomState(7)
+            init = {}
+            for name, g in prog_.geoms.items():
+                if g.is_scratch:
+                    continue
+                a = np.zeros(tuple(g.shape), np.float32)
+                idx = tuple(
+                    slice(g.origin[dn], g.origin[dn] + prog_.sizes[dn])
+                    if kind == "domain" else slice(None)
+                    for dn, kind in g.axes)
+                shape = a[idx].shape
+                if name == "vel":
+                    a[idx] = 0.0005 + rng.rand(*shape).astype(np.float32) \
+                        * 0.0005
+                else:
+                    a[idx] = (rng.rand(*shape).astype(np.float32) - 0.5) * 0.1
+                init[name] = np.asarray(a, dtype=prog_.dtype)
+            return init
 
-    # 5) tuned bench
+        state = prog.alloc_state(init=seeded_init())
+        interp = plat != "tpu"   # only under YT_TPU_SESSION_FORCE
+        from yask_tpu.ops.pallas_stencil import default_vmem_budget
+        budget = default_vmem_budget(plat)
+
+        def time_chunk(tag, prog_=None, state_=None, metric=None,
+                       npts=None, **kw):
+            """Time one chunk variant; returns its one-chunk output state
+            (or None on failure) so A/B stages can cross-validate.  The
+            default (prog, state) pair is the fp32 flagship; the bf16 stage
+            passes its own so the timing/recording protocol stays single-
+            definition."""
+            prog_ = prog_ or prog
+            state_ = state_ if state_ is not None else state
+            try:
+                chunk, tb = build_pallas_chunk(prog_, interpret=interp,
+                                               vmem_budget=budget, **kw)
+                fn = chunk if interp else \
+                    jax.jit(chunk).lower(state_, 0).compile()
+                st1 = fn(state_, 0)
+                jax.block_until_ready(st1)
+                st = st1
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    st = fn(st, 0)
+                jax.block_until_ready(st)
+                dt = (time.perf_counter() - t0) / 5
+                k = kw.get("fuse_steps", 1)
+                gpts = round((npts or gi ** 3) * k / dt / 1e9, 2)
+                log(tag, **{k2: v for k2, v in kw.items()},
+                    tile_mib=round(tb / 2**20, 2),
+                    secs_per_chunk=round(dt, 5), gpts=gpts)
+                if plat == "tpu":
+                    from bench import _record_tpu_result
+                    _record_tpu_result({
+                        "metric": metric or (f"iso3dfd r=8 {gi}^3 fp32 tpu "
+                                             f"pallas chunk ({tag} {kw})"),
+                        "value": gpts, "unit": "GPts/s", "platform": plat,
+                        "vs_baseline": round(gpts / 500.0, 4)})
+                return st1
+            except Exception as e:  # noqa: BLE001
+                log(tag, error=str(e)[:300], **kw)
+                return None
+
+        def max_abs_diff(a, b):
+            m = 0.0
+            for n in a:
+                for x, y in zip(a[n], b[n]):
+                    m = max(m, float(jax.numpy.max(jax.numpy.abs(x - y))))
+            return m
+
+        unpiped = time_chunk("pipeline_ab", fuse_steps=2,
+                             pipeline_dmas=False, skew=False)
+        piped = time_chunk("pipeline_ab", fuse_steps=2, pipeline_dmas=True,
+                           skew=False)
+        if unpiped is not None and piped is not None:
+            # bit-equality promised by the protocol: double-buffering must
+            # not change values (the aliasing hazard CLAUDE.md documents)
+            log("pipeline_ab", fuse_steps=2,
+                max_abs_diff=float(max_abs_diff(unpiped, piped)))
+        # skew A/B: uniform shrink vs streaming skewed wavefront, growing
+        # K; the two tilings must agree numerically on real Mosaic (first
+        # hardware execution of the carry machinery)
+        for k in (2, 4):
+            uni = time_chunk("skew_ab", fuse_steps=k, skew=False)
+            skw = time_chunk("skew_ab", fuse_steps=k, skew=True)
+            if uni is not None and skw is not None:
+                log("skew_ab", fuse_steps=k,
+                    max_abs_diff=float(max_abs_diff(uni, skw)))
+
+        # 3a2) misaligned-radius skew (E_sk window widening, r % sublane
+        #      != 0): the sublane-rounded write windows + widened regions
+        #      have only ever run in interpret mode — force skew on a
+        #      cube r=1 K=4 chunk and bit-compare against uniform.
+        try:
+            gq = min(gi, 128)
+            progc = create_solution("cube", radius=1).get_soln().compile() \
+                .plan(IdxTuple(x=gq, y=gq, z=gq),
+                      extra_pad={"x": (32, 32), "y": (32, 32), "z": (0, 0)})
+            statec = progc.alloc_state(init=seeded_init(progc))
+            uni_c = time_chunk(
+                "esk_ab", prog_=progc, state_=statec, npts=gq ** 3,
+                metric=f"cube r=1 {gq}^3 tpu pallas chunk (esk_ab uniform)",
+                fuse_steps=4, skew=False)
+            skw_c = time_chunk(
+                "esk_ab", prog_=progc, state_=statec, npts=gq ** 3,
+                metric=f"cube r=1 {gq}^3 tpu pallas chunk (esk_ab skew)",
+                fuse_steps=4, skew=True)
+            if uni_c is not None and skw_c is not None:
+                log("esk_ab", fuse_steps=4,
+                    max_abs_diff=float(max_abs_diff(uni_c, skw_c)))
+        except Exception as e:  # noqa: BLE001
+            log("esk_ab", error=str(e)[:300])
+
+        # 3b) bf16 A/B: the half-traffic roofline lever.  The CPU proxy
+        #     inverts (bf16 is software-emulated off-TPU) so only this
+        #     hardware row can confirm the >=1.5x target; sublane-16
+        #     geometry is exercised by the same chunk builder, and the
+        #     timing/recording protocol is time_chunk's single definition.
+        try:
+            from yask_tpu.compiler.solution_base import create_solution as _cs
+            sb16 = _cs("iso3dfd", radius=8)
+            sb16.get_soln().set_element_bytes(2)
+            prog16 = sb16.get_soln().compile().plan(
+                IdxTuple(x=gi, y=gi, z=gi),
+                extra_pad={"x": (32, 32), "y": (32, 32), "z": (0, 0)})
+            state16 = prog16.alloc_state(init=seeded_init(prog16))
+            time_chunk("bf16_ab", prog_=prog16, state_=state16,
+                       metric=f"iso3dfd r=8 {gi}^3 bf16 tpu pallas chunk K2",
+                       fuse_steps=2)
+        except Exception as e:  # noqa: BLE001
+            log("bf16_ab", error=str(e)[:300])
+
+        # 4) joint auto-tune at the bench size.  tune_max_wf_steps stays
+        #    small: pads are planned for radius × the cap, so 16 would
+        #    inflate every state array (784^3 for 512^3 at r=8) and make
+        #    each candidate compile minutes long.
+        from yask_tpu.runtime.auto_tuner import AutoTuner
+        ctx = build(fac, env, "iso3dfd", "pallas", g_bench, 8, wf=2,
+                    tune=True, tune_max=4)
+        ctx.get_settings().auto_tune_trial_secs = 0.5
+        try:
+            tuner = AutoTuner(ctx)
+            best_k = tuner.run_auto_tuner_now()
+            s = ctx.get_settings()
+            log("tune", wf_steps=best_k,
+                blocks={d: s.block_sizes[d] for d in ("x", "y")},
+                candidates=len(tuner.results))
+        except Exception as e:  # noqa: BLE001
+            log("tune", error=str(e)[:300])
+
+        # 5) tuned bench
+        try:
+            steps = 4 if quick else 20
+            ctx.run_solution(0, steps - 1)   # warm
+            ctx.clear_stats()
+            ctx.run_solution(steps, 2 * steps - 1)
+            st = ctx.get_stats()
+            rate = st.get_pts_per_sec() / 1e9
+            # roofline fraction: modeled HBM bytes/point × measured rate vs
+            # the device's peak bandwidth (the MFU-style number the
+            # performance doc's table wants per VERDICT r4 item 1)
+            rb, wb = ctx.hbm_model_bytes_pp()
+            peak = env.get_hbm_peak_bytes_per_sec()
+            roof = (rate * 1e9 * (rb + wb) / peak) if peak else 0.0
+            line = dict(
+                metric=f"iso3dfd r=8 {g_bench}^3 fp32 tpu pallas-tuned",
+                value=round(rate, 3), unit="GPts/s", platform=plat,
+                hbm_bytes_pp=round(rb + wb, 2),
+                roofline_frac=round(roof, 4),
+                vs_baseline=round(rate / 500.0, 4))
+            log("bench", **line)
+            if plat == "tpu":
+                # persist for bench.py's last_tpu_measured fallback
+                from bench import _record_tpu_result
+                _record_tpu_result(line)
+        except Exception as e:  # noqa: BLE001
+            log("bench", error=str(e)[:300])
+            return 1
+        return 0
+
+
     try:
-        steps = 4 if quick else 20
-        ctx.run_solution(0, steps - 1)   # warm
-        ctx.clear_stats()
-        ctx.run_solution(steps, 2 * steps - 1)
-        st = ctx.get_stats()
-        rate = st.get_pts_per_sec() / 1e9
-        # roofline fraction: modeled HBM bytes/point × measured rate vs
-        # the device's peak bandwidth (the MFU-style number the
-        # performance doc's table wants per VERDICT r4 item 1)
-        rb, wb = ctx.hbm_model_bytes_pp()
-        peak = env.get_hbm_peak_bytes_per_sec()
-        roof = (rate * 1e9 * (rb + wb) / peak) if peak else 0.0
-        line = dict(
-            metric=f"iso3dfd r=8 {g_bench}^3 fp32 tpu pallas-tuned",
-            value=round(rate, 3), unit="GPts/s", platform=plat,
-            hbm_bytes_pp=round(rb + wb, 2),
-            roofline_frac=round(roof, 4),
-            vs_baseline=round(rate / 500.0, 4))
-        log("bench", **line)
-        if plat == "tpu":
-            # persist for bench.py's last_tpu_measured fallback
-            from bench import _record_tpu_result
-            _record_tpu_result(line)
+        rc = perf_stages()
     except Exception as e:  # noqa: BLE001
-        log("bench", error=str(e)[:300])
-        return 1
+        log("perf", error=str(e)[:300])
+        rc = 1
+
+    # 5b) quick sessions validate AFTER the perf stages are banked
+    if quick:
+        run_matrix()
 
     # 6) Mosaic compile-time pathology check (LAST: mid-r3 saw ssg-K2 /
     #    swe2d compiles >15 min; a hang here must not cost the session).
@@ -330,7 +367,7 @@ def main(argv=None) -> int:
             except Exception as e:  # noqa: BLE001
                 log("compile_time", stencil=name, max_vinstr=cap,
                     error=str(e)[:200])
-    return 0
+    return rc
 
 
 if __name__ == "__main__":
