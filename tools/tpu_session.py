@@ -138,11 +138,11 @@ def main(argv=None) -> int:
     if not quick:
         run_matrix()
 
-    def perf_stages() -> int:
-        """Stages 3-5 (chunk A/Bs, joint tune, tuned bench).  Any
-        crash here — setup included — must not cost the deferred
-        validation matrix or the compile-time stage: the relay window
-        may still be healthy (round-3 failure mode)."""
+    def chunk_ab_stages() -> None:
+        """Stage 3 (chunk A/Bs), setup included.  Crash-isolated from
+        stages 4-5: the tune/bench build their own context, so a
+        failure planning the flagship chunk must not cost the
+        session's headline hardware number (round-3 failure mode)."""
         # 3) pipeline + skew A/Bs (timing on real DMA engines).  Each stage
         #    is isolated: a Mosaic failure in one A/B must not cost the rest
         #    of the session (the relay window may be short).
@@ -292,6 +292,9 @@ def main(argv=None) -> int:
         except Exception as e:  # noqa: BLE001
             log("bf16_ab", error=str(e)[:300])
 
+    def tune_bench_stages() -> int:
+        """Stages 4-5 (joint tune + tuned bench): independent context,
+        crash-isolated from the chunk A/Bs."""
         # 4) joint auto-tune at the bench size.  tune_max_wf_steps stays
         #    small: pads are planned for radius × the cap, so 16 would
         #    inflate every state array (784^3 for 512^3 at r=8) and make
@@ -341,10 +344,16 @@ def main(argv=None) -> int:
         return 0
 
 
+    rc = 0
     try:
-        rc = perf_stages()
+        chunk_ab_stages()
     except Exception as e:  # noqa: BLE001
-        log("perf", error=str(e)[:300])
+        log("chunk_abs", error=str(e)[:300])
+        rc = 1
+    try:
+        rc = tune_bench_stages() or rc
+    except Exception as e:  # noqa: BLE001
+        log("tune", error=str(e)[:300])
         rc = 1
 
     # 5b) quick sessions validate AFTER the perf stages are banked

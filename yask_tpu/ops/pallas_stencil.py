@@ -339,6 +339,36 @@ def skew_auto_engages(program, fuse_steps: int) -> bool:
     return (fuse_steps + 1) * r + e_sk < 2 * fuse_steps * r
 
 
+def skew_plan_hints(program, fuse_steps: int, engaged=None):
+    """(min_block, margin_override) for :func:`plan_blocks` when the
+    skewed wavefront engages — THE shared definition for the build and
+    the auto-tuner's seed plan: the stream block is floored at the
+    carry minimum (ring+1)·r, and the stream margin modeled as the
+    (K+1)·r + E_sk the skew actually fetches (not 2·K·r).  ``engaged``
+    overrides the auto decision (the build passes its resolved
+    use_skew, which may be an explicit skew=True).  Returns
+    (None, None) when skew won't run."""
+    if engaged is None:
+        engaged = skew_auto_engages(program, fuse_steps)
+    if not engaged:
+        return None, None
+    from yask_tpu.compiler.lowering import tpu_tile_dims
+    ana = program.ana
+    sdim = ana.domain_dims[:-1][-1]
+    r = ana.fused_step_radius().get(sdim, 0)
+    sub_t, _ = tpu_tile_dims(program.dtype)
+    e_sk = 2 * sub_t if r % sub_t != 0 else 0
+    ring_reads = set()
+    for sr_ in program.stage_reads:
+        ring_reads.update(sr_.keys())
+    cv_d = max((len(program_state_slots(program, n))
+                for n, g in program.geoms.items()
+                if g.is_written and not g.is_scratch
+                and n in ring_reads), default=0)
+    smin = {sdim: (cv_d + 1) * r} if cv_d else None
+    return smin, {sdim: (fuse_steps + 1) * r + e_sk}
+
+
 def default_vmem_budget(platform: str) -> int:
     """Device-derived Pallas VMEM *tile* budget (overridable via
     ``-vmem_mb``). Probed on v5e: ≥120 MiB VMEM is usable once the
@@ -510,19 +540,13 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     explicit_block = block is not None
     if block is None:
         from yask_tpu.ops.tile_planner import plan_blocks
-        smin = None
-        if use_skew:
-            # the carry save-strips must come from the tile's own valid
-            # region: stream blocks below (ring+1)·r would silently
-            # forfeit the skew, so floor the planner there
-            cv_d = max((len(program_state_slots(program, n))
-                        for n, g in program.geoms.items()
-                        if g.is_written and not g.is_scratch
-                        and n in ring_read_vars), default=0)
-            if cv_d:
-                smin = {sdim: (cv_d + 1) * R_s0}
+        # carry floor + skewed stream-margin model, shared with the
+        # auto-tuner's seed plan (skew_plan_hints)
+        smin, smarg = (skew_plan_hints(program, K, engaged=True)
+                       if use_skew else (None, None))
         block = plan_blocks(program, fuse_steps=K, vmem_budget=vmem_budget,
-                            vinstr_cap=vinstr_cap, min_block=smin)
+                            vinstr_cap=vinstr_cap, min_block=smin,
+                            margin_override=smarg)
     else:
         block = {d: min(b, sizes[d]) for d, b in zip(lead, block)}
 

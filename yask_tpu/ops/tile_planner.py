@@ -31,7 +31,8 @@ def sublane_count(dtype) -> int:
 def plan_blocks(program, fuse_steps: int = 1,
                 vmem_budget: int = 100 * 2 ** 20,
                 vinstr_cap: int = 300_000,
-                min_block: Optional[Dict[str, int]] = None
+                min_block: Optional[Dict[str, int]] = None,
+                margin_override: Optional[Dict[str, int]] = None
                 ) -> Dict[str, int]:
     """Choose leading-dim block sizes for the Pallas path.
 
@@ -40,6 +41,13 @@ def plan_blocks(program, fuse_steps: int = 1,
     growth stops at the cap so op-heavy kernels (ssg, awp, tti) cannot
     reach tile sizes whose Mosaic schedule blows up compile time
     (>15 min observed mid-r3 on ssg-K2).  0 disables the cap.
+
+    ``margin_override`` replaces the default uniform ``2·r·K`` TOTAL
+    tile margin per dim in the VMEM/overhead/vinstr models — the build
+    passes the skewed stream dim's ``(K+1)·r + E_sk`` so the planner
+    does not leave budget on the table modeling margins the skew never
+    fetches (at 512³ r=8 K=2 this is the difference between 8-wide and
+    16-wide x blocks).
     """
     ana = program.ana
     dims = ana.domain_dims
@@ -48,6 +56,12 @@ def plan_blocks(program, fuse_steps: int = 1,
     sizes = {d: program.sizes[d] for d in dims}
     rad = ana.fused_step_radius()
     hK = {d: rad.get(d, 0) * fuse_steps for d in lead}
+    # TOTAL extra tile width per dim in the models below (both-side
+    # margins); the skewed stream dim fetches less than 2*hK
+    marg = {d: 2 * hK[d] for d in lead}
+    for d, m in (margin_override or {}).items():
+        if d in marg:
+            marg[d] = m
     sub = sublane_count(program.dtype)
 
     fold = program.soln.get_settings().fold
@@ -113,7 +127,7 @@ def plan_blocks(program, fuse_steps: int = 1,
     def tile_bytes(blk):
         per = 1
         for d in lead:
-            per *= blk[d] + 2 * hK[d]
+            per *= blk[d] + marg[d]
         return per * minor_ext * esize * max(nbuf + nlive, 1)
 
     num_ops = getattr(getattr(ana, "counters", None), "num_ops", 0)
@@ -124,7 +138,7 @@ def plan_blocks(program, fuse_steps: int = 1,
         tile, repeated for every fused sub-step."""
         per = 1
         for d in lead:
-            per *= blk[d] + 2 * hK[d]
+            per *= blk[d] + marg[d]
         vregs = per * minor_ext / (sub * 128)
         return num_ops * fuse_steps * vregs
 
@@ -154,7 +168,7 @@ def plan_blocks(program, fuse_steps: int = 1,
         padded = 1
         for d in lead:
             interior *= blk[d]
-            padded *= blk[d] + 2 * hK[d]
+            padded *= blk[d] + marg[d]
         return (padded - interior) / max(interior, 1)
 
     improved = True

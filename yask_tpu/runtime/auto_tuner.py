@@ -270,9 +270,17 @@ class AutoTuner:
         if any(bs[d] > 0 for d in lead):
             blk0 = tuple(bs[d] if bs[d] > 0 else 8 for d in lead)
         else:
+            # seed with the same carry-floor + skewed-margin hints the
+            # build's default plan uses, or the walk wastes trials
+            # re-discovering the build's own block shape
+            from yask_tpu.ops.pallas_stencil import skew_plan_hints
+            smin, smarg = ((None, None)
+                           if not ctx._opts.skew_wavefront
+                           else skew_plan_hints(ctx._program, k0))
             planned = plan_blocks(ctx._program, fuse_steps=k0,
                                   vmem_budget=ctx.vmem_budget(),
-                                  vinstr_cap=ctx._opts.max_tile_vinstr)
+                                  vinstr_cap=ctx._opts.max_tile_vinstr,
+                                  min_block=smin, margin_override=smarg)
             blk0 = tuple(planned[d] for d in lead)
         return blk0
 
