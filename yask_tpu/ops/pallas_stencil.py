@@ -321,6 +321,18 @@ def skew_eligible(program, fuse_steps: int) -> bool:
     return r > 0
 
 
+def skew_extra_width(dtype, r: int) -> int:
+    """E_sk: the extra computed stream-dim width a skewed region needs
+    when the radius is not a sublane multiple (write-back shifts round
+    DOWN to the tile and the window widens by one tile; need
+    E ≥ d + sub_t with d = shift−floor(shift) < sub_t ⇒ 2·sub_t).
+    THE single definition — the profit gate, the planner hints, the
+    build's margins, and the runtime's pad planning must all agree."""
+    from yask_tpu.compiler.lowering import tpu_tile_dims
+    sub_t, _ = tpu_tile_dims(dtype)
+    return 2 * sub_t if r % sub_t != 0 else 0
+
+
 def skew_auto_engages(program, fuse_steps: int) -> bool:
     """Would :func:`build_pallas_chunk` auto-engage the skewed wavefront
     (``skew=None``, single device)?  Eligibility AND the profit gate:
@@ -330,12 +342,10 @@ def skew_auto_engages(program, fuse_steps: int) -> bool:
     traffic model, so bench/stats describe the tiling actually run."""
     if not skew_eligible(program, fuse_steps):
         return False
-    from yask_tpu.compiler.lowering import tpu_tile_dims
     ana = program.ana
     lead = ana.domain_dims[:-1]
     r = ana.fused_step_radius().get(lead[-1], 0)
-    sub_t, _ = tpu_tile_dims(program.dtype)
-    e_sk = 2 * sub_t if r % sub_t != 0 else 0
+    e_sk = skew_extra_width(program.dtype, r)
     return (fuse_steps + 1) * r + e_sk < 2 * fuse_steps * r
 
 
@@ -352,12 +362,10 @@ def skew_plan_hints(program, fuse_steps: int, engaged=None):
         engaged = skew_auto_engages(program, fuse_steps)
     if not engaged:
         return None, None
-    from yask_tpu.compiler.lowering import tpu_tile_dims
     ana = program.ana
     sdim = ana.domain_dims[:-1][-1]
     r = ana.fused_step_radius().get(sdim, 0)
-    sub_t, _ = tpu_tile_dims(program.dtype)
-    e_sk = 2 * sub_t if r % sub_t != 0 else 0
+    e_sk = skew_extra_width(program.dtype, r)
     ring_reads = set()
     for sr_ in program.stage_reads:
         ring_reads.update(sr_.keys())
@@ -416,10 +424,14 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     TPU-native answer to the reference's two-phase trapezoid blocking
     (``setup.cpp:863``, ``context.cpp:838``), whose phase coloring exists
     to create *thread* parallelism a sequential Pallas grid does not
-    need.  ``None`` = auto: on for single-device K ≥ 2 when the geometry
-    is eligible.  Distributed chunks keep the uniform shrink: the skewed
-    left margin would need (2K−1)·r-wide exchanged ghosts, but
-    shard_pallas plans (and exchanges) radius×K.
+    need.  ``None`` = auto: on for K ≥ 2 when the geometry is eligible
+    AND the margin model says it pays (``skew_auto_engages``).
+    Distributed chunks may skew too, but only along an UNSHARDED
+    stream dim (``stream_unsharded``): the carry then never crosses a
+    shard boundary and the radius×K ghost pads cover the skew margins
+    whenever the profit gate engages (mR = r+E_sk ≤ r·K exactly when
+    E_sk < (K−1)·r); a mesh-decomposed stream dim keeps the uniform
+    shrink.
     """
     import jax
     import jax.numpy as jnp
@@ -478,7 +490,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # overwrite the sub_t-wide overlap with identical valid values).
     skew_ok = skew_eligible(program, K)
     R_s0 = rad.get(sdim, 0) if sdim else 0
-    E_sk_c = 2 * sub_t if R_s0 % sub_t != 0 else 0
+    E_sk_c = skew_extra_width(program.dtype, R_s0)
     # Distributed chunks may skew only along an UNSHARDED stream dim
     # (``stream_unsharded``, asserted by the shard planner): the carry
     # strips then never cross a shard boundary, each shard spans the

@@ -272,10 +272,16 @@ class AutoTuner:
         else:
             # seed with the same carry-floor + skewed-margin hints the
             # build's default plan uses, or the walk wastes trials
-            # re-discovering the build's own block shape
+            # re-discovering the build's own block shape.  shard_pallas
+            # with a mesh-decomposed stream dim never skews
+            # (stream_unsharded=False in shard_step), so the seed must
+            # model uniform margins there — same guard as the HBM model.
             from yask_tpu.ops.pallas_stencil import skew_plan_hints
-            smin, smarg = ((None, None)
-                           if not ctx._opts.skew_wavefront
+            skew_possible = ctx._opts.skew_wavefront
+            if skew_possible and ctx._opts.mode == "shard_pallas" \
+                    and lead and ctx._opts.num_ranks[lead[-1]] > 1:
+                skew_possible = False
+            smin, smarg = ((None, None) if not skew_possible
                            else skew_plan_hints(ctx._program, k0))
             planned = plan_blocks(ctx._program, fuse_steps=k0,
                                   vmem_budget=ctx.vmem_budget(),

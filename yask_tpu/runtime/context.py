@@ -308,13 +308,14 @@ class StencilContext:
                 need_r = need
                 if d == lead[-1] and self._opts.skew_wavefront:
                     # Misaligned (non-sublane-multiple) stream radii:
-                    # the skewed tiling computes E_sk = 2·sub_t extra
-                    # right width and its widened slabs need the same
-                    # again in rounding room (see pallas_stencil E_sk).
-                    from yask_tpu.compiler.lowering import tpu_tile_dims
-                    sub_t, _ = tpu_tile_dims(self._csol.dtype)
-                    if step_rad.get(d, 0) % sub_t != 0:
-                        need_r = need + 4 * sub_t
+                    # the skewed tiling computes E_sk extra right width
+                    # and its widened slabs need the same again in
+                    # rounding room (single E_sk definition:
+                    # pallas_stencil.skew_extra_width).
+                    from yask_tpu.ops.pallas_stencil import \
+                        skew_extra_width
+                    need_r = need + 2 * skew_extra_width(
+                        self._csol.dtype, step_rad.get(d, 0))
                 l, r = extra[d]
                 extra[d] = (max(l, need), max(r, need_r))
         # Mosaic lane/sublane alignment only serves the manual-DMA Pallas
