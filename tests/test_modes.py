@@ -427,5 +427,22 @@ def test_resident_element_access_without_materialization(env):
     # state must still be device-resident after the element accesses
     # (the whole point of the escape hatch) ...
     assert sp._resident is not None and sp._state is None
+    # interior slice get/set also ride the resident fast path
+    box_r = ref.get_var("pressure").get_elements_in_slice(
+        [8, 4, 4, 4], [8, 11, 11, 11])
+    box_s = sp.get_var("pressure").get_elements_in_slice(
+        [8, 4, 4, 4], [8, 11, 11, 11])
+    assert sp._resident is not None and sp._state is None
+    assert np.allclose(box_s, box_r, rtol=1e-3, atol=1e-4)
+    for c in (ref, sp):
+        c.get_var("pressure").set_elements_in_slice(
+            np.full((8, 8, 8), 0.125, np.float32),
+            [8, 4, 4, 4], [8, 11, 11, 11])
+    assert sp._resident is not None and sp._state is None
+    c2 = sp.get_var("pressure").get_elements_in_slice(
+        [8, 4, 4, 4], [8, 11, 11, 11])
+    assert np.all(c2 == 0.125)
+    ref.run_solution(8, 9)
+    sp.run_solution(8, 9)
     # ... and the physics must agree with the jit twin exactly
     assert sp.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
