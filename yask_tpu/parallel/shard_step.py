@@ -280,6 +280,15 @@ def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
     import jax
     import jax.numpy as jnp
 
+    # Real hardware needs a longer sample: sub-ms dispatches against a
+    # ~0.05 s window made the fraction noise-prone (the (real − twin)
+    # subtraction amplifies jitter), and calibration runs once per
+    # variant so the extra cost is bounded.
+    on_hw = ctx._env.get_platform() == "tpu"
+    min_secs = 0.25 if on_hw else 0.05
+    max_calls = 64 if on_hw else 8
+    min_calls = 4 if on_hw else 2
+
     def timed(f):
         st = {k: [jnp.copy(a) for a in ring]
               for k, ring in interior.items()}
@@ -289,11 +298,12 @@ def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
         # repeat until the sample is long enough to be stable
         calls = 0
         t0 = time.perf_counter()
-        while calls < 8:
+        while calls < max_calls:
             st = f(st, t)
             jax.block_until_ready(st)
             calls += 1
-            if time.perf_counter() - t0 >= 0.05 and calls >= 2:
+            if time.perf_counter() - t0 >= min_secs \
+                    and calls >= min_calls:
                 break
         return (time.perf_counter() - t0) / calls
 
