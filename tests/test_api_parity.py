@@ -347,3 +347,21 @@ def test_reference_compiler_api_names_covered(env):
     assert ran == ["pre-out"]
     ctx = yk_factory().new_solution(env, soln2)
     assert "post-new" in ran and ctx is not None
+
+
+def test_element_bytes_accessor(env):
+    """yk_solution::get_element_bytes parity (driven by the reference's
+    swe_main.cpp:398): runtime accessor reflects the compiled dtype."""
+    from yask_tpu import yk_factory
+    from yask_tpu.compiler.solution_base import create_solution
+    fac = yk_factory()
+    c4 = fac.new_solution(env, stencil="cube")
+    c4.apply_command_line_options("-g 8")
+    c4.prepare_solution()
+    assert c4.get_element_bytes() == 4
+    sb = create_solution("cube")
+    sb.get_soln().set_element_bytes(2)
+    c2 = fac.new_solution(env, sb)
+    c2.apply_command_line_options("-g 8")
+    c2.prepare_solution()
+    assert c2.get_element_bytes() == 2
