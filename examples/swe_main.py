@@ -165,13 +165,15 @@ def main(argv=None) -> int:
         assert abs(ring - 1.0) > 1e-4, "wave never reached r=g/4"
 
     if o.plot:
-        # crude ASCII contour
-        q = np.linspace(h.min(), h.max(), 5)
+        # crude ASCII contour, normalized so even a nearly-flat field
+        # shows its structure
         chars = " .:*#"
-        for row in h[:: max(g // 32, 1)]:
-            print("".join(
-                chars[int(np.searchsorted(q, v, side="right")) - 1]
-                for v in row[:: max(g // 64, 1)]))
+        hf = np.nan_to_num(h, nan=0.0, posinf=0.0, neginf=0.0)
+        span = max(float(hf.max() - hf.min()), 1e-12)
+        lv = np.clip(((hf - hf.min()) / span * (len(chars) - 1)) + 0.5,
+                     0, len(chars) - 1).astype(int)
+        for row in lv[:: max(g // 32, 1)]:
+            print("".join(chars[v] for v in row[:: max(g // 64, 1)]))
 
     ctx.end_solution()
     env.finalize()

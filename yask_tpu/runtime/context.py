@@ -927,32 +927,11 @@ class StencilContext:
                    for d in self._ana.domain_dims[:-1]
                    if self._opts.block_sizes[d] > 0} or None
             K = max(1, self._opts.wf_steps)
-            # Prefer the tiling the built kernel ACTUALLY chose (skew can
-            # auto-fall-back during planning — ADVICE r3); predict only
-            # when nothing has been built yet for this configuration.
-            built = None
-            if self._opts.mode == "pallas":
-                key, _blk, _skw = self._pallas_build_key(K)
-                built = self._pallas_tiling.get(key)
-            else:
-                # shard_pallas records its inner chunk's tiling under
-                # ("shard_pallas", K, blk) — distributed skew can now
-                # engage (stream dim unsharded), so the model must use
-                # what actually ran, not assume uniform margins.  Key
-                # on the exact (K, blk) the run path derives, or an
-                # auto-tune walk's other variants could shadow it.
-                bs = self._opts.block_sizes
-                sblk = None
-                if any(bs[d] > 0 for d in self._ana.domain_dims[:-1]):
-                    sblk = tuple(bs[d] if bs[d] > 0 else 8
-                                 for d in self._ana.domain_dims[:-1])
-                sskw = None if self._opts.skew_wavefront else False
-                built = self._pallas_tiling.get(
-                    ("shard_pallas", K, sblk, sskw))
+            built = self._built_pallas_tiling()
             if built is not None:
                 return self._program.hbm_bytes_per_point(
-                    fuse_steps=K, block=built["block"],
-                    skew=built["skew"])
+                    fuse_steps=built["fuse_steps"],
+                    block=built["block"], skew=built["skew"])
             from yask_tpu.ops.pallas_stencil import skew_auto_engages
             skw = (self._opts.skew_wavefront
                    and skew_auto_engages(self._program, K))
@@ -963,6 +942,33 @@ class StencilContext:
             return self._program.hbm_bytes_per_point(
                 fuse_steps=K, block=blk, skew=skw)
         return self._program.hbm_bytes_per_point()
+
+    def _built_pallas_tiling(self):
+        """The tiling the built kernel ACTUALLY chose for the current
+        configuration (skew/pipelining can auto-fall-back during
+        planning — ADVICE r3), or None before the first build / on
+        non-pallas modes.  Keys on the exact build key the run path
+        derives, or an auto-tune walk's other variants could shadow
+        it."""
+        if self._program is None or self._opts.mode not in (
+                "pallas", "shard_pallas"):
+            return None
+        K = max(1, self._opts.wf_steps)
+        # single blk/skw derivation: _pallas_build_key (the shard run
+        # path uses the identical formula)
+        _key, blk_, skw_ = self._pallas_build_key(K)
+        probe = (self._opts.mode, K, blk_, skw_)
+        t = self._pallas_tiling.get(probe)
+        if t is None:
+            # run paths clamp K to the run span (K = min(wf_steps, n)):
+            # a short run records under a smaller K — report the
+            # nearest built variant rather than predicting
+            cands = [k for k in self._pallas_tiling
+                     if k[0] == probe[0] and k[2:] == probe[2:]
+                     and k[1] <= K]
+            if cands:
+                t = self._pallas_tiling[max(cands, key=lambda k: k[1])]
+        return t
 
     def get_stats(self) -> yk_stats:
         c = self._ana.counters
@@ -978,7 +984,8 @@ class StencilContext:
             halo_exchange_secs=self._halo_xround_last,
             halo_pack_secs=self._halo_xpack_last,
             read_bytes_pp=rb_pp, write_bytes_pp=wb_pp,
-            hbm_peak=self._env.get_hbm_peak_bytes_per_sec())
+            hbm_peak=self._env.get_hbm_peak_bytes_per_sec(),
+            tiling=self._built_pallas_tiling())
         return st
 
     def clear_stats(self) -> None:

@@ -17,7 +17,7 @@ class yk_stats:
                  halo_exchange_secs: float = 0.0,
                  halo_pack_secs: float = 0.0,
                  read_bytes_pp: float = 0.0, write_bytes_pp: float = 0.0,
-                 hbm_peak: float = 0.0):
+                 hbm_peak: float = 0.0, tiling: dict | None = None):
         self._npts = npts
         self._nsteps = nsteps
         self._nreads_pp = nreads_pp
@@ -31,6 +31,13 @@ class yk_stats:
         self._rb_pp = read_bytes_pp
         self._wb_pp = write_bytes_pp
         self._hbm_peak = hbm_peak
+        self._tiling = tiling
+
+    def get_tiling(self) -> dict | None:
+        """The Pallas tiling the built kernel actually chose (blocks,
+        skew, pipelining flags, modeled margin overhead), or None on
+        non-pallas paths / before the first build."""
+        return self._tiling
 
     def get_num_elements(self) -> int:
         """Points in the global domain (per step)."""
@@ -120,4 +127,6 @@ class yk_stats:
                 f"{self.get_hbm_bytes_per_sec() / 1e9:.6g}\n"
                 f"hbm-roofline-fraction (%): "
                 f"{100.0 * self.get_hbm_roofline_fraction():.4g}\n"
-                f"compile-time (sec): {self._compile:.6g}\n")
+                + (f"pallas-tiling: {self._tiling}\n"
+                   if self._tiling else "")
+                + f"compile-time (sec): {self._compile:.6g}\n")
