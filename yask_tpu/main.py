@@ -197,6 +197,20 @@ def run_harness(argv: Optional[List[str]] = None, out=None) -> int:
         out.write(f"  stddev-throughput (num-points/sec): "
                   f"{statistics.stdev(rates):.6g}\n")
     out.write(f"  mid-throughput (GPts/s): {mid / 1e9:.6g}\n")
+    # roofline context for the mid rate (reference prints its full
+    # stats block; these are the TPU-meaningful lines)
+    st = ctx.get_stats()
+    bpp = st.get_hbm_bytes_per_point()
+    if bpp > 0:
+        out.write(f"  hbm-bytes-per-point (read+write): {bpp:.6g}\n")
+        # aggregate peak: mid is global points/sec over every chip
+        peak = env.get_hbm_peak_bytes_per_sec() \
+            * max(env.get_num_ranks(), 1)
+        if peak:
+            out.write(f"  hbm-roofline-fraction (%): "
+                      f"{100.0 * mid * bpp / peak:.4g}\n")
+    if st.get_tiling():
+        out.write(f"  pallas-tiling: {st.get_tiling()}\n")
     return 0
 
 

@@ -984,7 +984,10 @@ class StencilContext:
             halo_exchange_secs=self._halo_xround_last,
             halo_pack_secs=self._halo_xpack_last,
             read_bytes_pp=rb_pp, write_bytes_pp=wb_pp,
-            hbm_peak=self._env.get_hbm_peak_bytes_per_sec(),
+            # aggregate peak: throughput is global (all chips), so the
+            # roofline denominator must scale with the mesh size
+            hbm_peak=(self._env.get_hbm_peak_bytes_per_sec()
+                      * max(self._env.get_num_ranks(), 1)),
             tiling=self._built_pallas_tiling())
         return st
 

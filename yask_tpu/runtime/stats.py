@@ -36,8 +36,15 @@ class yk_stats:
     def get_tiling(self) -> dict | None:
         """The Pallas tiling the built kernel actually chose (blocks,
         skew, pipelining flags, modeled margin overhead), or None on
-        non-pallas paths / before the first build."""
-        return self._tiling
+        non-pallas paths / before the first build.  Returns a copy —
+        the underlying dict also drives the context's HBM traffic
+        model."""
+        if self._tiling is None:
+            return None
+        out = dict(self._tiling)
+        if isinstance(out.get("block"), dict):
+            out["block"] = dict(out["block"])
+        return out
 
     def get_num_elements(self) -> int:
         """Points in the global domain (per step)."""

@@ -35,11 +35,15 @@ KEYS = [
     "halo-pack (sec)",
     "halo-collective (sec)",
     "compile-time (sec)",
+    "hbm-bytes-per-point (read+write)",
+    "achieved-HBM (GB/s)",
+    "hbm-roofline-fraction (%)",
+    "pallas-tiling",
     "num-points-per-step",
     "domain",
 ]
 
-_LINE = re.compile(r"^\s*([\w\- ()/]+?):\s*(.+?)\s*$")
+_LINE = re.compile(r"^\s*([\w\- ()/+%]+?):\s*(.+?)\s*$")
 
 
 def scrape(text: str) -> Dict[str, str]:
