@@ -146,3 +146,45 @@ def test_fuzzed_solution_jit_matches_oracle(seed):
             bad = sm.compare_data(b, epsilon=1e-3, abs_epsilon=1e-4)
             assert bad == 0, \
                 f"seed {seed} (shard_map overlap={overlap}): {bad}"
+
+
+def test_fuzz_resident_reads_match_materialized():
+    """Random interior/pad-straddling boxes read identically through
+    the device-resident fast path and the strict materializing path —
+    the equivalence contract of the r5 escape hatch (element and slice
+    APIs must not depend on internal state residency)."""
+    import numpy as np
+    from yask_tpu import yk_factory
+    from yask_tpu.runtime.init_utils import init_solution_vars
+
+    fac = yk_factory()
+    env = fac.new_env()
+    g = 24
+    ctx = fac.new_solution(env, stencil="iso3dfd", radius=2)
+    ctx.apply_command_line_options(f"-g {g}")
+    ctx.get_settings().mode = "shard_map"
+    ctx.set_num_ranks("x", 4)
+    ctx.prepare_solution()
+    init_solution_vars(ctx)
+    ctx.run_solution(0, 3)
+    assert ctx._resident is not None
+
+    rng = np.random.RandomState(11)
+    v = ctx.get_var("pressure")
+    boxes = []
+    for _ in range(12):
+        lo = [int(rng.randint(0, g - 1)) for _ in range(3)]
+        hi = [int(rng.randint(l, g)) for l in lo]
+        boxes.append(([4] + lo, [4] + hi))
+    pts = [[4] + [int(rng.randint(0, g)) for _ in range(3)]
+           for _ in range(8)]
+
+    res_boxes = [v.get_elements_in_slice(a, b) for a, b in boxes]
+    res_pts = [v.get_element(p) for p in pts]
+    assert ctx._resident is not None  # reads stayed on the fast path
+
+    ctx._materialize_state()          # force the strict path
+    for (a, b), r in zip(boxes, res_boxes):
+        np.testing.assert_array_equal(v.get_elements_in_slice(a, b), r)
+    for p, r in zip(pts, res_pts):
+        assert v.get_element(p) == r
