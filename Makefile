@@ -6,7 +6,8 @@ PY ?= python
 TEST_ENV ?= PALLAS_AXON_POOL_IPS=
 
 .PHONY: all native capi test test-fast scratch-tests boundary-tests \
-        stages-tests mode-tests bench examples clean list-stencils
+        stages-tests mode-tests bench perfcheck examples clean \
+        list-stencils
 
 all: native test
 
@@ -38,6 +39,11 @@ mode-tests:
 
 bench:
 	$(PY) bench.py
+
+# quick bench rows through the regression sentinel: nonzero exit on an
+# unexplained breach (see tools/perfcheck.py; ledger = PERF_LEDGER.jsonl)
+perfcheck:
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) tools/perfcheck.py
 
 examples:
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) examples/swe_main.py

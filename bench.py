@@ -292,9 +292,46 @@ def main():
                     rate, mode = p[0], f"pallas-K{p[1]}"
                     bytes_pp = p[2]   # model of the winning kernel
             _run_suite_rows()
+            metric = (f"iso3dfd r=8 {g}^3 fp32 {platform} "
+                      f"throughput ({mode})")
+            # roofline context (VERDICT r2 item 8) via the shared
+            # perflab model; provenance + sentinel verdict make the
+            # contract line self-explaining (an r5-style slide reads as
+            # "noise" or "regression" in the artifact itself)
+            from yask_tpu.perflab import capture_provenance
+            from yask_tpu.perflab.roofline import roofline as _roofline
+            from yask_tpu.perflab.sentinel import guard_and_append
+            roof = _roofline(rate, bytes_pp, hbm_peak)
+            prov = capture_provenance(
+                platform=platform,
+                device_kind=(getattr(env.get_devices()[0],
+                                     "device_kind", "")
+                             if env.get_devices() else ""))
+            # re-measure hook (breach → noise-vs-regression verdict):
+            # rebuild the winning configuration from scratch so the
+            # second sample shares nothing with the first
+            if mode == "jit":
+                remeasure = lambda: measure(  # noqa: E731
+                    build(fac, env, g, mode="jit"), g,
+                    steps_per_trial, trials)
+            else:
+                K = int(mode.rsplit("K", 1)[-1])
+                remeasure = lambda: measure(  # noqa: E731
+                    build(fac, env, g, mode="pallas", wf=K), g,
+                    steps_per_trial, trials)
+            guard = {"status": "unrecorded"}
+            try:
+                lrow = guard_and_append(
+                    metric, round(rate, 3), "GPts/s", platform, "bench",
+                    prov, roofline=roof,
+                    extra={"mode": mode,
+                           "vs_baseline": round(rate / 500.0, 4)},
+                    remeasure=remeasure)
+                guard = lrow["guard"]
+            except Exception:
+                pass  # ledger I/O must never cost the contract line
             line = {
-                "metric": f"iso3dfd r=8 {g}^3 fp32 {platform} "
-                          f"throughput ({mode})",
+                "metric": metric,
                 "value": round(rate, 3),
                 "unit": "GPts/s",
                 # platform as a FIELD, not only in the metric string: a
@@ -302,14 +339,13 @@ def main():
                 # as "relay was down", not a perf collapse (VERDICT r3)
                 "platform": platform,
                 "vs_baseline": round(rate / 500.0, 4),
-                # roofline context (VERDICT r2 item 8): modeled HBM
-                # bytes/point × achieved rate vs the chip's peak
-                "hbm_bytes_pp": round(bytes_pp, 2),
-                "hbm_gbps": round(rate * bytes_pp, 1),
+                "hbm_bytes_pp": roof["hbm_bytes_pp"],
+                "hbm_gbps": roof["hbm_gbps"],
+                "provenance": prov,
+                "guard": guard,
             }
-            if hbm_peak > 0:
-                line["hbm_roofline"] = round(
-                    rate * 1e9 * bytes_pp / hbm_peak, 4)
+            if roof.get("roofline_frac") is not None:
+                line["hbm_roofline"] = roof["roofline_frac"]
             if on_tpu:
                 _record_tpu_result(line)
             else:

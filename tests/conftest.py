@@ -17,3 +17,11 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 # Keep compile times sane for the many tiny programs tests build.
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; register the marker so the
+    # perfcheck/bench integration tests can opt out without warnings
+    config.addinivalue_line(
+        "markers", "slow: timed perf/integration test excluded from the "
+        "tier-1 `-m 'not slow'` run")

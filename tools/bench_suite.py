@@ -16,6 +16,15 @@ path first, and the relay-down case falls back to CPU via bench.py's
 probe. Sizes shrink automatically off-TPU so the suite stays runnable
 on the virtual CPU mesh.
 
+Every row goes through ``yask_tpu.perflab``: it carries measurement
+provenance (load average, CPU model, git SHA, calibration rate — the
+context whose absence made the r5 across-the-board proxy slide
+uninvestigable), a sentinel guard verdict (trailing clean median +
+absolute floors, one automatic re-measure on breach deciding
+noise-vs-regression), roofline context where a traffic model exists,
+and is appended to ``PERF_LEDGER.jsonl``.  There are no ad-hoc guards
+left here — the old cube-wavefront floor is now a sentinel rule.
+
 Run: ``python tools/bench_suite.py``
 """
 
@@ -94,10 +103,31 @@ def validated_pallas(fac, env, name, radius, wf, gv=24, steps=4,
 #: them into the round artifact alongside its contract line).
 ROWS = []
 
+#: set by run_suite: (platform, device_kind) for per-row provenance.
+_ENV_INFO = {"platform": "", "device_kind": ""}
 
-def emit(metric, value, unit, **extra):
-    row = {"metric": metric, "value": round(value, 4), "unit": unit,
-           **extra}
+
+def emit(metric, value, unit, remeasure=None, roofline=None, **extra):
+    """Record one suite row: provenance + sentinel verdict + ledger
+    append, then the legacy-shaped JSON line (bench.py re-prints these
+    and the driver parser reads them — `metric`/`value`/`unit` keys stay
+    stable, provenance/guard ride along as extra fields)."""
+    from yask_tpu.perflab import capture_provenance, guard_and_append
+    value = round(value, 4)
+    prov = capture_provenance(platform=_ENV_INFO["platform"],
+                              device_kind=_ENV_INFO["device_kind"])
+    try:
+        lrow = guard_and_append(metric, value, unit,
+                                _ENV_INFO["platform"] or "cpu", "suite",
+                                prov, remeasure=remeasure,
+                                roofline=roofline, extra=extra or None)
+        guard = lrow["guard"]
+    except Exception as e:  # ledger I/O must never kill a bench section
+        guard = {"status": "unrecorded", "error": str(e)[:120]}
+    row = {"metric": metric, "value": value, "unit": unit, **extra,
+           "provenance": prov, "guard": guard}
+    if roofline:
+        row.update({k: v for k, v in roofline.items() if v is not None})
     ROWS.append(row)
     print(json.dumps(row), flush=True)
 
@@ -126,16 +156,24 @@ def run_suite(fac, env, budget_secs=None):
     on_tpu = plat == "tpu"
     ndev = env.get_num_ranks()
     ROWS.clear()
+    _ENV_INFO["platform"] = plat
+    _ENV_INFO["device_kind"] = (getattr(env.get_devices()[0],
+                                        "device_kind", "")
+                                if env.get_devices() else "")
     t0 = time.perf_counter()
 
     steps = 12 if on_tpu else 4   # multiple of 4: clean K=4 fusion groups
+
+    from yask_tpu.perflab.roofline import ctx_roofline
 
     def iso3dfd_jit():
         for g in ((512, 384, 256) if on_tpu else (48,)):
             try:
                 ctx = build(fac, env, "iso3dfd", 8, g, "jit")
-                emit(f"iso3dfd r=8 {g}^3 {plat} jit",
-                     measure(ctx, g ** 3, steps), "GPts/s")
+                rate = measure(ctx, g ** 3, steps)
+                emit(f"iso3dfd r=8 {g}^3 {plat} jit", rate, "GPts/s",
+                     remeasure=lambda: measure(ctx, g ** 3, steps),
+                     roofline=ctx_roofline(ctx, env, rate))
                 del ctx
                 return
             except Exception:
@@ -156,34 +194,43 @@ def run_suite(fac, env, budget_secs=None):
         validated_pallas(fac, env, "iso3dfd", 8, wf=2)
         g = 512 if on_tpu else 48
         ctx = build(fac, env, "iso3dfd", 8, g, "pallas", wf=2)
-        emit(f"iso3dfd r=8 {g}^3 {plat} pallas-K2",
-             measure(ctx, g ** 3, steps), "GPts/s", **_tiling_of(ctx))
+        rate = measure(ctx, g ** 3, steps)
+        emit(f"iso3dfd r=8 {g}^3 {plat} pallas-K2", rate, "GPts/s",
+             remeasure=lambda: measure(ctx, g ** 3, steps),
+             roofline=ctx_roofline(ctx, env, rate), **_tiling_of(ctx))
         del ctx
 
     def cube_wavefront():
+        # The K=4-over-K=1 fusion speedup.  The old ad-hoc 1.5× floor
+        # (VERDICT r4 item 3: the r4 proxy silently halved when skew
+        # mis-engaged at r=1) is now the sentinel's cube-wavefront rule;
+        # on a breach the guard re-measures the ratio once and records
+        # noise-vs-regression in the row itself.
         validated_pallas(fac, env, "cube", 1, wf=4)
         gc = 256 if on_tpu else 32
         c1 = build(fac, env, "cube", 1, gc, "pallas", wf=1)
         base = measure(c1, gc ** 3, steps)
-        del c1
         c4 = build(fac, env, "cube", 1, gc, "pallas", wf=4)
         fused = measure(c4, gc ** 3, steps)
-        speedup = fused / max(base, 1e-12)
-        # regression guard (VERDICT r4 item 3): the r4 proxy silently
-        # halved when skew auto-engaged at r=1 — flag any future slide
-        # in the artifact itself (test_skew pins the structural cause)
+
+        def remeasure_speedup():
+            return (measure(c4, gc ** 3, steps)
+                    / max(measure(c1, gc ** 3, steps), 1e-12))
+
         emit(f"cube 27pt {gc}^3 {plat} wavefront-speedup",
-             speedup, "x", k1_gpts=round(base, 4),
-             k4_gpts=round(fused, 4), **_tiling_of(c4),
-             **({"regression": f"speedup {speedup:.2f} < 1.5 floor"}
-                if speedup < 1.5 else {}))
-        del c4
+             fused / max(base, 1e-12), "x",
+             remeasure=remeasure_speedup,
+             k1_gpts=round(base, 4), k4_gpts=round(fused, 4),
+             **_tiling_of(c4))
+        del c1, c4
 
     def ssg_elastic():
         gs = 256 if on_tpu else 32
         ctx = build(fac, env, "ssg", 2, gs, "jit")
-        emit(f"ssg r=2 {gs}^3 {plat} jit",
-             measure(ctx, gs ** 3, steps), "GPts/s")
+        rate = measure(ctx, gs ** 3, steps)
+        emit(f"ssg r=2 {gs}^3 {plat} jit", rate, "GPts/s",
+             remeasure=lambda: measure(ctx, gs ** 3, steps),
+             roofline=ctx_roofline(ctx, env, rate))
         del ctx
 
     def iso3dfd_bf16():
@@ -196,8 +243,10 @@ def run_suite(fac, env, budget_secs=None):
         g = 512 if on_tpu else 48
         ctx = build(fac, env, "iso3dfd", 8, g, "pallas", wf=2,
                     elem_bytes=2)
-        emit(f"iso3dfd r=8 {g}^3 {plat} pallas-K2 bf16",
-             measure(ctx, g ** 3, steps), "GPts/s")
+        rate = measure(ctx, g ** 3, steps)
+        emit(f"iso3dfd r=8 {g}^3 {plat} pallas-K2 bf16", rate, "GPts/s",
+             remeasure=lambda: measure(ctx, g ** 3, steps),
+             roofline=ctx_roofline(ctx, env, rate))
         del ctx
 
     def awp_decomposed():
@@ -211,6 +260,8 @@ def run_suite(fac, env, budget_secs=None):
         halo_pct = (100.0 * st.get_halo_secs()
                     / max(st.get_elapsed_secs(), 1e-12))
         emit(f"awp {ga}^3 {plat} x{ndev} shard_map", rate, "GPts/s",
+             remeasure=lambda: measure(ctx, ga ** 3, steps),
+             roofline=ctx_roofline(ctx, env, rate),
              halo_pct=round(halo_pct, 2))
         del ctx
 

@@ -1,0 +1,69 @@
+"""Quick sentinel gate: measure the fast bench rows, fail on an
+unexplained breach.
+
+``make perfcheck`` runs the suite's CPU-proxy rows (small domains, a
+tight time budget) through ``yask_tpu.perflab``: every row gets
+provenance, a ledger append, and a guard verdict with one automatic
+re-measure on breach.  The exit code is the point —
+
+* 0: every row is ``ok`` / ``no_history`` / ``noise`` (a breach that
+  cleared on re-measure is load noise, explained in the row itself);
+* 1: some row's verdict is ``regression`` or ``breach`` (breached twice,
+  or breached with no re-measure hook), or a section crashed, or no
+  rows were produced at all.
+
+This replaces eyeballing BENCH JSON between rounds: a real perf slide
+turns red here first, with the trailing-median baseline and both samples
+recorded in ``PERF_LEDGER.jsonl``.
+
+Run: ``make perfcheck``  (or ``python tools/perfcheck.py [budget_secs]``)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+#: verdicts that fail the gate (everything else is ok or self-explained)
+FAIL_STATUSES = ("breach", "regression")
+
+
+def run(budget_secs: float = 300.0, out=None) -> int:
+    out = out or sys.stdout
+    from yask_tpu import yk_factory
+    from tools.bench_suite import run_suite
+    fac = yk_factory()
+    env = fac.new_env()
+    rows = run_suite(fac, env, budget_secs=budget_secs)
+    bad = []
+    for r in rows:
+        st = r.get("guard", {}).get("status", "")
+        if st in FAIL_STATUSES or r.get("unit") == "error":
+            bad.append(r)
+    ok = [r for r in rows if r not in bad]
+    out.write(f"perfcheck: {len(rows)} row(s), {len(ok)} clean, "
+              f"{len(bad)} failing\n")
+    for r in bad:
+        out.write("FAIL " + json.dumps(
+            {k: r.get(k) for k in ("metric", "value", "unit", "guard",
+                                   "error") if k in r}) + "\n")
+    if not rows:
+        out.write("perfcheck: no rows produced\n")
+        return 1
+    return 1 if bad else 0
+
+
+def main() -> int:
+    try:
+        budget = float(sys.argv[1]) if len(sys.argv) > 1 else 300.0
+    except ValueError:
+        return 2
+    return run(budget)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,6 +97,32 @@ def main(argv=None) -> int:
             "mode)")
         return 1
 
+    def record(line, roofline=None):
+        """Persist one measured TPU row twice: bench.py's
+        TPU_RESULTS.jsonl (the ``last_tpu_measured`` contract fallback)
+        and the unified perf ledger (source ``tpu_session``) with
+        provenance + a sentinel verdict — relay windows are short, so
+        every row is banked the moment it exists."""
+        try:
+            from bench import _record_tpu_result
+            _record_tpu_result(line)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from yask_tpu.perflab import capture_provenance
+            from yask_tpu.perflab.sentinel import guard_and_append
+            prov = capture_provenance(
+                platform=plat,
+                device_kind=(getattr(env.get_devices()[0], "device_kind",
+                                     "") if env.get_devices() else ""))
+            extra = {k: v for k, v in line.items()
+                     if k not in ("metric", "value", "unit", "platform")}
+            guard_and_append(line["metric"], line["value"], line["unit"],
+                             plat, "tpu_session", prov,
+                             roofline=roofline, extra=extra or None)
+        except Exception as e:  # noqa: BLE001
+            log("ledger", error=str(e)[:160])
+
     # 1) smoke
     ctx = build(fac, env, "iso3dfd", "jit", 128, 2)
     ctx.run_solution(0, 4)
@@ -212,8 +238,7 @@ def main(argv=None) -> int:
                     tile_mib=round(tb / 2**20, 2),
                     secs_per_chunk=round(dt, 5), gpts=gpts)
                 if plat == "tpu":
-                    from bench import _record_tpu_result
-                    _record_tpu_result({
+                    record({
                         "metric": metric or (f"iso3dfd r=8 {gi}^3 fp32 tpu "
                                              f"pallas chunk ({tag} {kw})"),
                         "value": gpts, "unit": "GPts/s", "platform": plat,
@@ -321,23 +346,21 @@ def main(argv=None) -> int:
             ctx.run_solution(steps, 2 * steps - 1)
             st = ctx.get_stats()
             rate = st.get_pts_per_sec() / 1e9
-            # roofline fraction: modeled HBM bytes/point × measured rate vs
-            # the device's peak bandwidth (the MFU-style number the
-            # performance doc's table wants per VERDICT r4 item 1)
-            rb, wb = ctx.hbm_model_bytes_pp()
-            peak = env.get_hbm_peak_bytes_per_sec()
-            roof = (rate * 1e9 * (rb + wb) / peak) if peak else 0.0
+            # roofline fraction via the shared perflab model (the
+            # MFU-style number the performance doc's table wants per
+            # VERDICT r4 item 1) — one definition across the harness,
+            # bench, suite, and this session
+            from yask_tpu.perflab.roofline import ctx_roofline
+            roof = ctx_roofline(ctx, env, rate)
             line = dict(
                 metric=f"iso3dfd r=8 {g_bench}^3 fp32 tpu pallas-tuned",
                 value=round(rate, 3), unit="GPts/s", platform=plat,
-                hbm_bytes_pp=round(rb + wb, 2),
-                roofline_frac=round(roof, 4),
+                hbm_bytes_pp=roof["hbm_bytes_pp"],
+                roofline_frac=roof["roofline_frac"] or 0.0,
                 vs_baseline=round(rate / 500.0, 4))
             log("bench", **line)
             if plat == "tpu":
-                # persist for bench.py's last_tpu_measured fallback
-                from bench import _record_tpu_result
-                _record_tpu_result(line)
+                record(line, roofline=roof)
         except Exception as e:  # noqa: BLE001
             log("bench", error=str(e)[:300])
             return 1

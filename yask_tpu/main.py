@@ -39,6 +39,7 @@ class HarnessSettings:
         self.pre_auto_tune = False
         self.trace = False
         self.profile_dir = ""     # jax.profiler trace output
+        self.ledger = False       # append the run to PERF_LEDGER.jsonl
         self.list_stencils = False
         self.help = False
 
@@ -66,6 +67,10 @@ class HarnessSettings:
             self, "profile_dir")
         p.add_float_option("init_seed", "Per-var init sequence seed.",
                            self, "init_seed")
+        p.add_bool_option(
+            "ledger", "Append the mid-throughput (with provenance, "
+            "roofline context, and a sentinel guard verdict) to the "
+            "unified perf ledger (PERF_LEDGER.jsonl).", self, "ledger")
         p.add_bool_option("auto_tune", "Pre-run the auto-tuner.",
                           self, "pre_auto_tune")
         p.add_bool_option("trace", "Enable trace messages.", self, "trace")
@@ -198,19 +203,40 @@ def run_harness(argv: Optional[List[str]] = None, out=None) -> int:
                   f"{statistics.stdev(rates):.6g}\n")
     out.write(f"  mid-throughput (GPts/s): {mid / 1e9:.6g}\n")
     # roofline context for the mid rate (reference prints its full
-    # stats block; these are the TPU-meaningful lines)
+    # stats block) — the shared perflab model, so the harness, bench,
+    # suite, and session all derive the fraction identically
+    from yask_tpu.perflab.roofline import ctx_roofline, format_roofline
     st = ctx.get_stats()
-    bpp = st.get_hbm_bytes_per_point()
-    if bpp > 0:
-        out.write(f"  hbm-bytes-per-point (read+write): {bpp:.6g}\n")
-        # aggregate peak: mid is global points/sec over every chip
-        peak = env.get_hbm_peak_bytes_per_sec() \
-            * max(env.get_num_ranks(), 1)
-        if peak:
-            out.write(f"  hbm-roofline-fraction (%): "
-                      f"{100.0 * mid * bpp / peak:.4g}\n")
+    roof = ctx_roofline(ctx, env, mid / 1e9)
+    if roof["hbm_bytes_pp"] > 0:
+        out.write(format_roofline(roof))
     if st.get_tiling():
         out.write(f"  pallas-tiling: {st.get_tiling()}\n")
+
+    if opts.ledger:
+        # one unified row per harness run: -ledger turns any ad-hoc
+        # measurement into a tracked series the sentinel can guard
+        from yask_tpu.perflab import capture_provenance
+        from yask_tpu.perflab.sentinel import guard_and_append
+        s = ctx.get_settings()
+        sizes = s.global_domain_sizes.make_val_str("x")
+        mode = getattr(ctx, "_mode", None) or s.mode
+        key = (f"{opts.stencil} g={sizes} {env.get_platform()} "
+               f"harness ({mode}"
+               + (f"-K{s.wf_steps}" if s.wf_steps > 1 else "") + ")")
+        prov = capture_provenance(
+            platform=env.get_platform(),
+            device_kind=(getattr(env.get_devices()[0], "device_kind",
+                                 "") if env.get_devices() else ""))
+        row = guard_and_append(
+            key, round(mid / 1e9, 4), "GPts/s", env.get_platform(),
+            "harness", prov, roofline=roof,
+            extra={"trials": opts.num_trials,
+                   "trial_steps": opts.trial_steps,
+                   **({"tiling": st.get_tiling()} if st.get_tiling()
+                      else {})})
+        out.write(f"ledger: recorded '{key}' "
+                  f"(guard {row['guard'].get('status')})\n")
     return 0
 
 

@@ -1,0 +1,39 @@
+"""perflab: benchmark provenance, the unified perf ledger, and the
+regression sentinel.
+
+The reference's identity is its measured trial protocol
+(``yask_main.cpp:131-139``); this package is what makes the numbers that
+protocol produces *actionable* across sessions and machines:
+
+* :mod:`yask_tpu.perflab.provenance` — machine/load context + a
+  calibration micro-kernel rate attached to every measurement, so rows
+  taken under different load or on different hosts are comparable;
+* :mod:`yask_tpu.perflab.ledger` — the append-only ``PERF_LEDGER.jsonl``
+  every perf producer in the repo writes through (bench.py contract
+  line, ``tools/bench_suite.py`` rows, harness ``-ledger`` runs,
+  ``tools/tpu_session.py`` hardware rows), with query helpers;
+* :mod:`yask_tpu.perflab.sentinel` — per-row regression guards
+  (trailing-median relative tolerance + absolute floors) with an
+  automatic single re-measure on breach and a noise-vs-regression
+  verdict recorded in the row;
+* :mod:`yask_tpu.perflab.roofline` — the single HBM-roofline model the
+  harness, bench, suite, and session all consume.
+
+``tools/perf_bisect.py`` replays one ledger row-key across a git
+revision range to localize regressions the sentinel flags.
+"""
+
+from yask_tpu.perflab.ledger import (append_row, default_ledger_path,
+                                     make_row, read_rows, trailing_median,
+                                     validate_row)
+from yask_tpu.perflab.provenance import capture_provenance
+from yask_tpu.perflab.roofline import roofline
+from yask_tpu.perflab.sentinel import (DEFAULT_RULES, GuardRule, check_row,
+                                       guard_and_append, is_clean)
+
+__all__ = [
+    "append_row", "default_ledger_path", "make_row", "read_rows",
+    "trailing_median", "validate_row", "capture_provenance", "roofline",
+    "DEFAULT_RULES", "GuardRule", "check_row", "guard_and_append",
+    "is_clean",
+]

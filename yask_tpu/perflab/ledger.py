@@ -1,0 +1,198 @@
+"""The unified perf ledger: one append-only JSONL every producer writes.
+
+``PERF_LEDGER.jsonl`` (repo root, override via ``YT_PERF_LEDGER``) is
+the single place perf numbers live between sessions.  One row schema
+covers every producer — the bench.py contract line, the
+``tools/bench_suite.py`` BASELINE rows, harness ``-ledger`` runs, the
+multichip dryrun, and hardware rows from ``tools/tpu_session.py``
+(legacy ``TPU_RESULTS.jsonl`` records convert via :func:`from_legacy`).
+
+Row schema (version 1)::
+
+    {"v": 1,
+     "key":      "iso3dfd r=8 128^3 fp32 cpu throughput (jit)",  # row-key
+     "value":    0.114, "unit": "GPts/s",
+     "platform": "cpu",
+     "source":   "bench",            # bench|suite|harness|tpu_session|...
+     "measured_at": "2026-08-05T12:00:00Z",
+     "provenance": {loadavg, ncpu, cpu_model, governor, jax, jaxlib,
+                    git_sha, env_fp, calib_gpts, ...},
+     # optional:
+     "guard":  {...}                 # sentinel verdict (sentinel.py)
+     "roofline": {hbm_bytes_pp, hbm_gbps, roofline_frac}
+     "extra":  {...}}                # producer-specific context (tiling,
+                                     # k1/k4 rates, halo %, error, ...)
+
+The *row-key* is the stable identity a measurement series shares: the
+sentinel's trailing median, ``tools/log_to_csv.py --ledger`` grouping,
+and ``tools/perf_bisect.py`` replay all key on it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+LEDGER_BASENAME = "PERF_LEDGER.jsonl"
+
+#: who measured the row; new producers register here so query tooling
+#: can enumerate them.
+KNOWN_SOURCES = ("bench", "suite", "harness", "tpu_session", "multichip",
+                 "bisect", "perfcheck", "test")
+
+_REQUIRED = ("v", "key", "value", "unit", "platform", "source",
+             "measured_at", "provenance")
+#: provenance keys every row must carry (the acceptance bar: rows are
+#: useless for cross-session comparison without them).
+_REQUIRED_PROV = ("loadavg", "cpu_model", "git_sha")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def default_ledger_path() -> str:
+    return os.environ.get("YT_PERF_LEDGER") or os.path.join(
+        repo_root(), LEDGER_BASENAME)
+
+
+def utc_now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def make_row(key: str, value: float, unit: str, platform: str,
+             source: str, provenance: Dict, guard: Optional[Dict] = None,
+             roofline: Optional[Dict] = None,
+             extra: Optional[Dict] = None,
+             measured_at: Optional[str] = None) -> Dict:
+    """Build (and validate) one schema-v1 ledger row."""
+    row = {
+        "v": SCHEMA_VERSION,
+        "key": str(key),
+        "value": float(value),
+        "unit": str(unit),
+        "platform": str(platform),
+        "source": str(source),
+        "measured_at": measured_at or utc_now(),
+        "provenance": dict(provenance),
+    }
+    if guard:
+        row["guard"] = dict(guard)
+    if roofline:
+        row["roofline"] = {k: v for k, v in roofline.items()
+                           if v is not None}
+    if extra:
+        row["extra"] = dict(extra)
+    validate_row(row)
+    return row
+
+
+def validate_row(row: Dict) -> None:
+    """Raise ValueError unless ``row`` conforms to the v1 schema."""
+    if not isinstance(row, dict):
+        raise ValueError(f"ledger row must be a dict, got {type(row)}")
+    missing = [k for k in _REQUIRED if k not in row]
+    if missing:
+        raise ValueError(f"ledger row missing field(s) {missing}: "
+                         f"{sorted(row)}")
+    if row["v"] != SCHEMA_VERSION:
+        raise ValueError(f"unknown ledger schema version {row['v']!r}")
+    if not isinstance(row["value"], (int, float)) \
+            or isinstance(row["value"], bool):
+        raise ValueError(f"row value must be numeric, got "
+                         f"{row['value']!r}")
+    if not row["key"]:
+        raise ValueError("row key must be non-empty")
+    prov = row["provenance"]
+    if not isinstance(prov, dict):
+        raise ValueError("provenance must be a dict")
+    pmissing = [k for k in _REQUIRED_PROV if k not in prov]
+    if pmissing:
+        raise ValueError(f"provenance missing {pmissing} "
+                         f"(capture_provenance supplies them)")
+
+
+def from_legacy(rec: Dict, source: str, provenance: Dict) -> Dict:
+    """Convert a legacy bench/TPU_RESULTS record ({"metric": ...,
+    "value": ..., "unit": ...}) into a v1 ledger row; roofline context
+    and leftover fields land in ``roofline``/``extra``."""
+    rec = dict(rec)
+    roof = {}
+    for src_k, dst_k in (("hbm_bytes_pp", "hbm_bytes_pp"),
+                         ("hbm_gbps", "hbm_gbps"),
+                         ("hbm_roofline", "roofline_frac"),
+                         ("roofline_frac", "roofline_frac")):
+        if src_k in rec:
+            roof[dst_k] = rec.pop(src_k)
+    key = rec.pop("metric", rec.pop("key", ""))
+    value = rec.pop("value", 0.0)
+    unit = rec.pop("unit", "")
+    platform = rec.pop("platform", provenance.get("platform", ""))
+    measured_at = rec.pop("measured_at", None)
+    return make_row(key, value, unit, platform, source, provenance,
+                    roofline=roof or None, extra=rec or None,
+                    measured_at=measured_at)
+
+
+def append_row(row: Dict, path: Optional[str] = None) -> Dict:
+    """Validate + append one row; returns the row.  Append-only by
+    contract: nothing in the repo rewrites or deletes ledger lines."""
+    validate_row(row)
+    with open(path or default_ledger_path(), "a") as f:
+        f.write(json.dumps(row, sort_keys=True) + "\n")
+    return row
+
+
+def read_rows(path: Optional[str] = None, key: Optional[str] = None,
+              platform: Optional[str] = None,
+              source: Optional[str] = None,
+              sha: Optional[str] = None) -> List[Dict]:
+    """All (optionally filtered) rows, file order == time order.
+    Malformed lines are skipped, never fatal — the ledger must stay
+    readable even if a producer crashed mid-write."""
+    path = path or default_ledger_path()
+    rows: List[Dict] = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    row = json.loads(ln)
+                except ValueError:
+                    continue
+                if not isinstance(row, dict):
+                    continue
+                if key is not None and row.get("key") != key:
+                    continue
+                if platform is not None \
+                        and row.get("platform") != platform:
+                    continue
+                if source is not None and row.get("source") != source:
+                    continue
+                if sha is not None and not str(
+                        row.get("provenance", {}).get("git_sha", "")
+                        ).startswith(sha):
+                    continue
+                rows.append(row)
+    except OSError:
+        pass
+    return rows
+
+
+def trailing_median(rows: List[Dict], n: int = 5,
+                    accept: Optional[Callable[[Dict], bool]] = None
+                    ) -> Optional[float]:
+    """Median value of the last ``n`` rows passing ``accept`` (default:
+    all) — the sentinel's baseline.  None with no accepted history."""
+    vals = [float(r["value"]) for r in rows
+            if accept is None or accept(r)][-n:]
+    if not vals:
+        return None
+    vals.sort()
+    return vals[len(vals) // 2]

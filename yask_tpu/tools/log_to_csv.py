@@ -5,9 +5,15 @@ Counterpart of ``utils/bin/yask_log_to_csv.pl`` + ``utils/lib/YaskUtils.pm``
 a CSV for performance tracking, throughput keys first (the reference ranks
 "mid" throughput as the primary fitness key).
 
+``--ledger`` flattens the unified perf ledger (``PERF_LEDGER.jsonl``,
+``yask_tpu.perflab``) instead: one CSV row per ledger row with the
+provenance, guard-verdict, and roofline columns spread out — the
+spreadsheet view of the append-only history.
+
 Usage::
 
     python -m yask_tpu.tools.log_to_csv run1.log run2.log > perf.csv
+    python -m yask_tpu.tools.log_to_csv --ledger [PERF_LEDGER.jsonl] > perf.csv
 """
 
 from __future__ import annotations
@@ -74,11 +80,60 @@ def logs_to_csv(paths: List[str], out=None) -> None:
         w.writerow(r)
 
 
+#: Ledger columns, identity → value → verdict → roofline → provenance.
+LEDGER_COLS = [
+    "key", "value", "unit", "platform", "source", "measured_at",
+    "guard_status", "guard_baseline", "guard_remeasured",
+    "roofline_frac", "hbm_gbps", "hbm_bytes_pp",
+    "git_sha", "load1", "ncpu", "calib_gpts", "cpu_model",
+    "device_kind", "jax", "env_fp",
+]
+
+
+def ledger_to_csv(path: str = "", out=None) -> int:
+    """Flatten ledger rows (see ``yask_tpu.perflab.ledger``) to CSV;
+    returns the number of rows written."""
+    from yask_tpu.perflab.ledger import default_ledger_path, read_rows
+    out = out or sys.stdout
+    rows = read_rows(path or default_ledger_path())
+    w = csv.DictWriter(out, fieldnames=LEDGER_COLS, extrasaction="ignore")
+    w.writeheader()
+    for r in rows:
+        prov = r.get("provenance", {})
+        guard = r.get("guard", {})
+        roof = r.get("roofline", {})
+        load = prov.get("loadavg") or [None]
+        w.writerow({
+            **{k: r.get(k) for k in ("key", "value", "unit", "platform",
+                                     "source", "measured_at")},
+            "guard_status": guard.get("status"),
+            "guard_baseline": guard.get("baseline"),
+            "guard_remeasured": guard.get("remeasured"),
+            "roofline_frac": roof.get("roofline_frac"),
+            "hbm_gbps": roof.get("hbm_gbps"),
+            "hbm_bytes_pp": roof.get("hbm_bytes_pp"),
+            "git_sha": prov.get("git_sha"),
+            "load1": load[0],
+            "ncpu": prov.get("ncpu"),
+            "calib_gpts": prov.get("calib_gpts"),
+            "cpu_model": prov.get("cpu_model"),
+            "device_kind": prov.get("device_kind"),
+            "jax": prov.get("jax"),
+            "env_fp": prov.get("env_fp"),
+        })
+    return len(rows)
+
+
 def main() -> None:  # pragma: no cover - thin wrapper
-    if len(sys.argv) < 2:
-        sys.stderr.write("usage: log_to_csv <log> [log...]\n")
+    args = sys.argv[1:]
+    if args and args[0] == "--ledger":
+        ledger_to_csv(args[1] if len(args) > 1 else "")
+        return
+    if not args:
+        sys.stderr.write(
+            "usage: log_to_csv <log> [log...] | --ledger [path]\n")
         sys.exit(2)
-    logs_to_csv(sys.argv[1:])
+    logs_to_csv(args)
 
 
 if __name__ == "__main__":
