@@ -446,3 +446,25 @@ def test_resident_element_access_without_materialization(env):
     sp.run_solution(8, 9)
     # ... and the physics must agree with the jit twin exactly
     assert sp.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+def test_resident_fill_apis_without_materialization(env):
+    """Whole-var fills (set_elements_in_seq / set_all_elements_same)
+    ride the device-resident interiors directly — the examples'
+    re-init-between-intervals pattern — instead of forcing the
+    materialize/re-pad round trip, and match the jit twin doing the
+    identical fills."""
+    def drive(mode, ranks=None):
+        ctx = _run_sp(env, "iso3dfd", mode, wf=1, ranks=ranks, steps=4)
+        ctx.get_var("pressure").set_elements_in_seq(seed=0.07)
+        ctx.get_var("vel").set_all_elements_same(0.375)
+        if ranks:
+            # the fills must not have materialized the resident state
+            assert ctx._resident is not None and ctx._state is None
+        ctx.run_solution(4, 7)
+        return ctx
+
+    ref = drive("jit")
+    sp = drive("shard_map", ranks=[("x", 4)])
+    assert sp._resident is not None and sp._state is None
+    assert sp.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0

@@ -188,9 +188,14 @@ def test_auto_tuner_joint_walk(env):
     tuner = AutoTuner(ctx)
     best_k = tuner.run_auto_tuner_now()
     keys = list(tuner.results)
-    # joint keys: (k, (bx, by)); multiple block shapes were explored
-    assert all(len(k) == 2 for k in keys)
-    assert len({blk for _, blk in keys}) > 1
+    # joint keys: (k, (bx, by)) — plus a vmem rung element when the
+    # 64/96/120 MiB budget ladder is active (the default -vmem_mb 0 /
+    # -tune_vmem_ladder state)
+    assert all(len(k) in (2, 3) for k in keys)
+    assert len({k[1] for k in keys}) > 1
+    if any(len(k) == 3 for k in keys):
+        # the ladder actually walked more than one budget rung
+        assert len({k[2] for k in keys}) > 1
     assert best_k == ctx.get_settings().wf_steps
     lead_blocks = [ctx.get_block_size(d) for d in ("x", "y")]
     assert all(b > 0 for b in lead_blocks)
@@ -265,8 +270,9 @@ def test_auto_tuner_shard_pallas_joint_walk(env):
     best_k = tuner.run_auto_tuner_now()
     keys = [k for k in tuner.results if k[0] == "sp"]
     assert keys, "shard_pallas walk produced no trials"
-    # blocks were explored, not just K (the r2 weakness)
-    assert len({blk for _, _, blk in keys}) > 1
+    # blocks were explored, not just K (the r2 weakness); keys gain a
+    # vmem rung element when the budget ladder is active (the default)
+    assert len({k[2] for k in keys}) > 1
     assert best_k == ctx.get_settings().wf_steps
     # real state was untouched by trials; a tuned run stays exact
     ref = mk("ref")
@@ -333,11 +339,14 @@ def test_tuned_pad_replan_shrinks_and_migrates(env):
         return ctx
 
     ctx = mk("pallas", tune=True)
-    assert ctx._program.geoms["pressure"].pads["x"] == (18, 18)
+    # left: halo 2 + radius×Kmax 16; right additionally carries the
+    # skew-window overshoot headroom 2·sub_t (context._pallas_pad_needs
+    # — x sits in the default -skew_dims 2 window)
+    assert ctx._program.geoms["pressure"].pads["x"] == (18, 34)
     ctx.get_settings().wf_steps = 2
     ctx._tuned = True
     ctx._replan_pallas_pads(2)
-    assert ctx._program.geoms["pressure"].pads["x"] == (6, 6)
+    assert ctx._program.geoms["pressure"].pads["x"] == (6, 22)
     ctx.run_solution(0, 3)
     ref = mk("jit", tune=False)
     ref.run_solution(0, 3)
@@ -469,3 +478,33 @@ def test_plan_blocks_vinstr_cap(env):
     chunk, _ = build_pallas_chunk(prog, fuse_steps=2, block=blk,
                                   interpret=True)
     assert chunk.tiling["block"] == tight
+
+
+def test_plan_blocks_min_block_survives_divisor_snap(env):
+    """Regression (r6): a skew carry floor that is NOT a divisor of the
+    dim size must snap UP to the next divisor — never silently land
+    below the floor (the carry would then not fit and the build would
+    forfeit the skewed tiling)."""
+    from yask_tpu.ops.tile_planner import plan_blocks
+    ctx = yk_factory().new_solution(env, stencil="iso3dfd", radius=8)
+    ctx.apply_command_line_options("-g_x 40 -g_y 40 -g_z 128")
+    ctx.get_settings().mode = "pallas"
+    ctx.get_settings().wf_steps = 2
+    ctx.prepare_solution()
+    prog = ctx._program
+    # 16 does not divide 40: the floor must yield 20 (next divisor up),
+    # in every floored dim independently
+    blocks = plan_blocks(prog, fuse_steps=2,
+                         min_block={"x": 16, "y": 16})
+    for d in ("x", "y"):
+        assert blocks[d] >= 16
+        assert 40 % blocks[d] == 0
+    # a floor above the dim size clamps to the full dim
+    blocks = plan_blocks(prog, fuse_steps=2, min_block={"y": 64})
+    assert blocks["y"] == 40
+    # the floor must not bypass the vinstr compile-time guard: with a
+    # prohibitive cap the dim is left alone (build falls back to
+    # uniform tiling instead of a pathological Mosaic schedule)
+    capped = plan_blocks(prog, fuse_steps=2, min_block={"y": 16},
+                         vinstr_cap=1)
+    assert capped["y"] < 16

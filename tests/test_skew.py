@@ -199,30 +199,48 @@ def test_skew_off_knob(env):
 
 
 def test_skew_auto_engage_is_profit_gated(env):
-    """skew=None auto-engages only when the skew margin model beats
-    uniform shrink: (K+1)·r + E_sk < 2·K·r.  Misaligned small radii
-    (cube r=1) must stay uniform — auto-engaging them regressed the
-    round-4 cube-wavefront proxy 2.07× → 1.26× (E_sk=16 extra width
-    per 32-wide tile).  Explicit skew=True still forces the path."""
+    """skew=None auto-engages PER DIM only when that dim's skew margin
+    beats uniform shrink: (K+1)·r + E_d < 2·K·r.  Misaligned small
+    stream radii (cube r=1) must keep the STREAM dim uniform —
+    auto-engaging it regressed the round-4 cube-wavefront proxy
+    2.07× → 1.26× (E_sk=16 extra width per 32-wide tile) — while the
+    outer dim (E=0) still profits.  max_skew_dims=1 reproduces the
+    pre-multi-dim stream-only arm, so the gated-out stream leaves the
+    tiling fully uniform.  Explicit skew=True still forces the
+    stream-dim path."""
     from yask_tpu.ops.pallas_stencil import build_pallas_chunk
 
-    # r=8 aligned, K=2: profitable (24 vs 32) → auto-skew ON
+    # r=8 aligned, K=2: profitable (24 vs 32) → auto-skew ON, and the
+    # stream dim is among the engaged dims
     iso = make(env, "pallas", "iso3dfd", r=8, g=48, wf=2,
                block={"x": 24, "y": 24})
     ch, _ = build_pallas_chunk(iso._program, fuse_steps=2,
                                block=(24, 24), interpret=True)
     assert ch.tiling["skew"] is True
+    iso_lead = iso._program.ana.domain_dims[:-1]
+    assert iso_lead[-1] in ch.tiling["skew_dims"]
 
-    # r=1 misaligned, K=4: E_sk=16 ⇒ 21 vs 8 → auto-skew OFF
+    # r=1 misaligned, K=4: E_sk=16 ⇒ 21 vs 8 → the stream dim stays
+    # uniform; the outer dim (E=0, 5 < 8) engages on its own
     cube = make(env, "pallas", "cube", r=1, g=32, wf=4)
+    lead = cube._program.ana.domain_dims[:-1]
     ch, _ = build_pallas_chunk(cube._program, fuse_steps=4,
                                interpret=True)
-    assert ch.tiling["skew"] is False
+    assert lead[-1] not in ch.tiling["skew_dims"]
 
-    # …but an explicit skew=True still builds and matches the oracle
+    # -skew_dims 1 = the 1-D A/B arm: stream dim ONLY — the outer dim
+    # must not silently swap in, so the whole tiling is uniform
+    ch1, _ = build_pallas_chunk(cube._program, fuse_steps=4,
+                                interpret=True, max_skew_dims=1)
+    assert ch1.tiling["skew"] is False
+    assert ch1.tiling["skew_dims"] == []
+
+    # …but an explicit skew=True still builds (stream dim forced) and
+    # matches the oracle
     sk, _ = build_pallas_chunk(cube._program, fuse_steps=4,
                                interpret=True, skew=True)
     assert sk.tiling["skew"] is True
+    assert sk.tiling["skew_dims"] == [lead[-1]]
 
 
 def test_skew_distributed_stream_unsharded(env):
@@ -255,6 +273,8 @@ def test_skew_distributed_stream_unsharded(env):
     til = [t for k, t in sp._pallas_tiling.items()
            if k[0] == "shard_pallas"]
     assert til and til[0]["skew"] is True
+    # x is mesh-decomposed → only the (unsharded) stream dim engages
+    assert til[0]["skew_dims"] == ["y"]
 
     un = mk("shard_pallas", ranks=[("x", 2)], skew=False)
     un.run_solution(0, 3)
@@ -264,11 +284,156 @@ def test_skew_distributed_stream_unsharded(env):
     assert til_u and til_u[0]["skew"] is False
     assert til[0]["margin_overhead"] < til_u[0]["margin_overhead"]
 
-    # stream dim decomposed -> skew must NOT engage (carry would cross
-    # the shard boundary); uniform tiling still matches
+    # stream dim decomposed -> the STREAM dim must not engage (its
+    # carry would cross the shard boundary); the outer dim is still
+    # whole on every shard and may skew on its own — equivalence holds
     sy = mk("shard_pallas", ranks=[("y", 2)])
     sy.run_solution(0, 3)
     assert sy.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
     til_y = [t for k, t in sy._pallas_tiling.items()
              if k[0] == "shard_pallas"]
-    assert til_y and til_y[0]["skew"] is False
+    assert til_y and "y" not in til_y[0]["skew_dims"]
+
+
+# ---- multi-dim (2-D) skew ------------------------------------------------
+
+
+def test_skew_per_dim_gate_and_widths(env):
+    """Unit coverage for THE shared per-dim decision helpers: E_sk is
+    paid only by the stream (sublane-window) dim, the profit gate
+    evaluates per dim, ``max_dims`` is a positional window (1 = the
+    stream dim only, never the outer dim swapped in), and ``unsharded``
+    drops mesh-decomposed dims individually."""
+    from yask_tpu.ops.pallas_stencil import (skew_engaged_dims,
+                                             skew_extra_widths)
+
+    cube = make(env, "pallas", "cube", r=1, g=32, wf=4)
+    prog = cube._program
+    lead = prog.ana.domain_dims[:-1]
+    e = skew_extra_widths(prog, 4)
+    assert e[lead[-1]] == 16      # r=1 misaligned: 2·sub_t widening
+    assert e[lead[-2]] == 0       # outer dim is an untiled DMA axis
+    # stream gate fails ((K+1)·1+16 ≥ 2·4·1); outer (E=0) passes
+    assert skew_engaged_dims(prog, 4) == [lead[-2]]
+    assert skew_engaged_dims(prog, 4, max_dims=1) == []
+    assert skew_engaged_dims(prog, 4, max_dims=0) == []
+
+    iso = make(env, "pallas", "iso3dfd", r=8, g=48, wf=2)
+    ip = iso._program
+    il = ip.ana.domain_dims[:-1]
+    assert skew_engaged_dims(ip, 2) == list(il[-2:])
+    assert skew_engaged_dims(ip, 2, max_dims=1) == [il[-1]]
+    assert skew_engaged_dims(ip, 2, unsharded=[il[-1]]) == [il[-1]]
+    assert skew_engaged_dims(ip, 2, unsharded=[il[-2]]) == [il[-2]]
+    assert skew_engaged_dims(ip, 2, unsharded=[]) == []
+
+
+def test_skew_plan_hints_per_dim(env):
+    """Planner hints carry per-dim carry floors ((ring+1)·r) and per-dim
+    skew margins ((K+1)·r + E_d) for exactly the engaged dims."""
+    from yask_tpu.ops.pallas_stencil import skew_plan_hints
+
+    iso = make(env, "pallas", "iso3dfd", r=8, g=48, wf=2)
+    il = iso._program.ana.domain_dims[:-1]
+    smin, smarg = skew_plan_hints(iso._program, 2)
+    assert set(smarg) == set(il[-2:])
+    assert smarg == {d: 3 * 8 for d in il[-2:]}   # (K+1)·r, E=0 aligned
+    assert smin is not None and set(smin) == set(il[-2:])
+    for d in smin:
+        assert smin[d] > 0 and smin[d] % 8 == 0   # (ring+1)·8
+
+    cube = make(env, "pallas", "cube", r=1, g=32, wf=4)
+    cl = cube._program.ana.domain_dims[:-1]
+    # legacy forced-1-D form: the stream dim's margin pays its E_sk
+    _, sm1 = skew_plan_hints(cube._program, 4, engaged=True)
+    assert sm1 == {cl[-1]: 5 * 1 + 16}
+    # auto: only the outer dim engages, margin (K+1)·r with E=0
+    _, sm2 = skew_plan_hints(cube._program, 4)
+    assert sm2 == {cl[-2]: 5}
+    # explicitly disengaged
+    assert skew_plan_hints(cube._program, 4, engaged=False) == (None, None)
+
+
+def test_skew2d_forced_matches_uniform(env):
+    """Forcing BOTH lead dims (skew=[x, y]) must agree bit-for-bit with
+    the uniform tiling on the same state — incl. the misaligned cube
+    where auto would gate the stream dim out (forcing overrides the
+    profit gate, not eligibility)."""
+    from yask_tpu.ops.pallas_stencil import build_pallas_chunk
+
+    for name, r, g, wf, blk in [("iso3dfd", 8, 48, 2, (24, 24)),
+                                ("cube", 1, 32, 4, (16, 16))]:
+        ctx = make(env, "pallas", name, r=r, g=g, wf=wf,
+                   block={"x": blk[0], "y": blk[1]})
+        lead = ctx._program.ana.domain_dims[:-1]
+        sk, _ = build_pallas_chunk(ctx._program, fuse_steps=wf,
+                                   block=blk, interpret=True,
+                                   skew=list(lead))
+        assert sk.tiling["skew_dims"] == list(lead)
+        un, _ = build_pallas_chunk(ctx._program, fuse_steps=wf,
+                                   block=blk, interpret=True, skew=False)
+        st = {k: list(v) for k, v in ctx._state.items()}
+        a = sk(st, 0)
+        b = un(st, 0)
+        for n in a:
+            for x, y in zip(a[n], b[n]):
+                np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                           rtol=2e-5, atol=1e-6)
+
+
+def test_skew2d_auto_matches_jit(env):
+    """End-to-end: default settings (skew_dims_max=2) auto-engage both
+    lead dims on the aligned flagship; the run matches the XLA oracle
+    and the modeled margin overhead is strictly below the uniform
+    tiling's (the whole point of the second dim)."""
+    ref = make(env, "jit", "iso3dfd", r=8, g=48)
+    ref.run_solution(0, 3)
+
+    p = make(env, "pallas", "iso3dfd", r=8, g=48, wf=2,
+             block={"x": 24, "y": 24})
+    p.run_solution(0, 3)
+    assert p.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+    til = p.get_stats().get_tiling()
+    assert sorted(til["skew_dims"]) == \
+        sorted(p._program.ana.domain_dims[:-1])
+
+    un = make(env, "pallas", "iso3dfd", r=8, g=48, wf=2,
+              block={"x": 24, "y": 24}, skew=False)
+    un.run_solution(0, 3)
+    assert un.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+    tu = un.get_stats().get_tiling()
+    assert til["margin_overhead"] < tu["margin_overhead"]
+
+
+def test_skew2d_fallback_ladder(env):
+    """Auto-engaged skew whose blocks sit below a dim's carry floor
+    steps DOWN the ladder per dim — 2-D → 1-D (outer dim dropped) →
+    uniform — while a forced request surfaces the constraint."""
+    from yask_tpu.ops.pallas_stencil import (build_pallas_chunk,
+                                             skew_plan_hints)
+
+    ctx = make(env, "pallas", "iso3dfd", r=8, g=48, wf=2)
+    prog = ctx._program
+    lead = prog.ana.domain_dims[:-1]
+    smin, _ = skew_plan_hints(prog, 2, engaged=list(lead))
+    lo = {d: smin[d] - 8 for d in lead}     # below the carry floor
+    hi = {d: smin[d] + 8 for d in lead}
+
+    # outer dim below its floor → steps down to 1-D stream skew
+    ch, _ = build_pallas_chunk(prog, fuse_steps=2,
+                               block=(lo[lead[0]], hi[lead[1]]),
+                               interpret=True)
+    assert ch.tiling["skew_dims"] == [lead[-1]]
+
+    # both below the floor → fully uniform
+    ch0, _ = build_pallas_chunk(prog, fuse_steps=2,
+                                block=(lo[lead[0]], lo[lead[1]]),
+                                interpret=True)
+    assert ch0.tiling["skew"] is False
+    assert ch0.tiling["skew_dims"] == []
+
+    # forced skew on an infeasible block raises instead of falling back
+    with pytest.raises(YaskException):
+        build_pallas_chunk(prog, fuse_steps=2,
+                           block=(lo[lead[0]], lo[lead[1]]),
+                           interpret=True, skew=list(lead))

@@ -64,7 +64,7 @@ def measure(ctx, g_pts, steps, trials=3):
 
 
 def build(fac, env, name, radius, g, mode, wf=0, ranks=(),
-          measure_halo=False, elem_bytes=None):
+          measure_halo=False, elem_bytes=None, extra_opts=""):
     from yask_tpu.runtime.init_utils import init_solution_vars
     if elem_bytes:
         from yask_tpu.compiler.solution_base import create_solution
@@ -76,6 +76,8 @@ def build(fac, env, name, radius, g, mode, wf=0, ranks=(),
     opts = f"-g {g} -wf_steps {wf}"
     if measure_halo:
         opts += " -measure_halo"
+    if extra_opts:
+        opts += " " + extra_opts
     ctx.apply_command_line_options(opts)
     ctx.get_settings().mode = mode
     for d, r in ranks:
@@ -185,7 +187,8 @@ def run_suite(fac, env, budget_secs=None):
         provenance (skew / pipelining can auto-fall-back)."""
         for t in ctx._pallas_tiling.values():
             if t:
-                return {k: t[k] for k in ("skew", "pipeline_dmas",
+                return {k: t[k] for k in ("skew", "skew_dims",
+                                          "pipeline_dmas",
                                           "pipeline_out",
                                           "margin_overhead") if k in t}
         return {}
@@ -223,6 +226,29 @@ def run_suite(fac, env, budget_secs=None):
              k1_gpts=round(base, 4), k4_gpts=round(fused, 4),
              **_tiling_of(c4))
         del c1, c4
+
+    def iso3dfd_skew2d():
+        # 1-D vs 2-D skew A/B via the -skew_dims knob: the second
+        # (outer-dim, E=0) carry trades its row buffer for another
+        # 2·K·r → (K+1)·r margin drop — track the payoff as a ratio so
+        # the sentinel sees mis-engagement (the r4 cube lesson, one
+        # dim up).
+        g = 512 if on_tpu else 48
+        c1 = build(fac, env, "iso3dfd", 8, g, "pallas", wf=2,
+                   extra_opts="-skew_dims 1")
+        r1 = measure(c1, g ** 3, steps)
+        c2 = build(fac, env, "iso3dfd", 8, g, "pallas", wf=2)
+        r2 = measure(c2, g ** 3, steps)
+
+        def remeasure_ratio():
+            return (measure(c2, g ** 3, steps)
+                    / max(measure(c1, g ** 3, steps), 1e-12))
+
+        emit(f"iso3dfd r=8 {g}^3 {plat} skew2d-speedup",
+             r2 / max(r1, 1e-12), "x", remeasure=remeasure_ratio,
+             skew1d_gpts=round(r1, 4), skew2d_gpts=round(r2, 4),
+             **_tiling_of(c2))
+        del c1, c2
 
     def ssg_elastic():
         gs = 256 if on_tpu else 32
@@ -265,8 +291,9 @@ def run_suite(fac, env, budget_secs=None):
              halo_pct=round(halo_pct, 2))
         del ctx
 
-    for fn in (iso3dfd_jit, iso3dfd_pallas, cube_wavefront, ssg_elastic,
-               iso3dfd_bf16, awp_decomposed):
+    for fn in (iso3dfd_jit, iso3dfd_pallas, cube_wavefront,
+               iso3dfd_skew2d, ssg_elastic, iso3dfd_bf16,
+               awp_decomposed):
         section(fn, t0, budget_secs)
     return list(ROWS)
 

@@ -219,9 +219,10 @@ def main(argv=None) -> int:
             definition."""
             prog_ = prog_ or prog
             state_ = state_ if state_ is not None else state
+            vb = kw.pop("vmem_budget", budget)
             try:
                 chunk, tb = build_pallas_chunk(prog_, interpret=interp,
-                                               vmem_budget=budget, **kw)
+                                               vmem_budget=vb, **kw)
                 fn = chunk if interp else \
                     jax.jit(chunk).lower(state_, 0).compile()
                 st1 = fn(state_, 0)
@@ -273,6 +274,28 @@ def main(argv=None) -> int:
             if uni is not None and skw is not None:
                 log("skew_ab", fuse_steps=k,
                     max_abs_diff=float(max_abs_diff(uni, skw)))
+            # 1-D vs 2-D: force BOTH lead dims (the multi-dim carry's
+            # first hardware execution) and bit-compare against the
+            # 1-D arm — the second dim's row carry + diagonal corner
+            # propagation must agree exactly on real Mosaic
+            sk2 = time_chunk("skew2d_ab", fuse_steps=k,
+                             metric=(f"iso3dfd r=8 {gi}^3 fp32 tpu "
+                                     f"pallas chunk (skew2d K{k})"),
+                             skew=["x", "y"])
+            if skw is not None and sk2 is not None:
+                log("skew2d_ab", fuse_steps=k,
+                    max_abs_diff=float(max_abs_diff(skw, sk2)))
+
+        # 3a3) vmem-budget ladder, measured directly: the joint tuner's
+        #      outer axis (64 MiB pins 8×32 blocks at the 512^3
+        #      flagship; 96 MiB admits 16×32 — the r5 open item).  Each
+        #      rung is its own ledger row so the sweep is comparable
+        #      across sessions.
+        for mb in (64, 96, 120):
+            time_chunk("vmem_ladder", fuse_steps=2,
+                       metric=(f"iso3dfd r=8 {gi}^3 fp32 tpu pallas "
+                               f"chunk (vmem {mb} MiB)"),
+                       vmem_budget=mb * 2 ** 20)
 
         # 3a2) misaligned-radius skew (E_sk window widening, r % sublane
         #      != 0): the sublane-rounded write windows + widened regions
@@ -334,6 +357,7 @@ def main(argv=None) -> int:
             s = ctx.get_settings()
             log("tune", wf_steps=best_k,
                 blocks={d: s.block_sizes[d] for d in ("x", "y")},
+                vmem_mb=s.vmem_budget_mb,   # ladder-chosen rung (0=auto)
                 candidates=len(tuner.results))
         except Exception as e:  # noqa: BLE001
             log("tune", error=str(e)[:300])

@@ -411,7 +411,7 @@ class StepProgram:
 
     def hbm_bytes_per_point(self, fuse_steps: int = 1,
                             block: Optional[Dict[str, int]] = None,
-                            skew: bool = False
+                            skew=False
                             ) -> Tuple[float, float]:
         """Modeled HBM traffic per interior point per STEP as
         ``(read_bytes, write_bytes)`` — the roofline yardstick next to
@@ -421,9 +421,11 @@ class StepProgram:
         writes each produced slot once; scratch vars never leave VMEM).
         ``fuse_steps``/``block`` model the pallas K-group: reads pay the
         tile-halo overlap factor and amortize over K.  ``skew`` models
-        the streaming skewed wavefront: the innermost blocked dim
-        fetches (K+1)·r of margin instead of 2·K·r (the inter-tile
-        strips ride the VMEM carry)."""
+        the streaming skewed wavefront: each skewed blocked dim fetches
+        (K+1)·r + E of margin instead of 2·K·r (the inter-tile strips
+        ride the VMEM carry).  Accepts the legacy bool (True = the
+        innermost blocked dim) or the per-dim form — a collection of
+        dim names, as reported by ``chunk.tiling['skew_dims']``."""
         import numpy as np
         esize = np.dtype(self.dtype).itemsize
         dompts = 1
@@ -433,6 +435,10 @@ class StepProgram:
         rad = self.ana.fused_step_radius()
         lead = self.ana.domain_dims[:-1]
         sdim = lead[-1] if lead else None
+        if isinstance(skew, (list, tuple, set, frozenset)):
+            skew_dims = set(skew)
+        else:
+            skew_dims = {sdim} if (skew and sdim is not None) else set()
         rd = 0.0
         wr = 0.0
         for name, g in self.geoms.items():
@@ -447,12 +453,16 @@ class StepProgram:
                 num = den = 1.0
                 for d in lead:
                     if d in g.domain_dims and block.get(d):
-                        if skew and d == sdim:
+                        if d in skew_dims:
+                            # only the sublane (stream) dim pays E_sk:
                             # misaligned radii add 2·sub_t of computed
-                            # right margin (E_sk, see pallas_stencil)
+                            # right margin (see pallas_stencil); outer
+                            # skewed dims are untiled (E = 0)
                             r_ = rad.get(d, 0)
-                            sub_t = tpu_tile_dims(self.dtype)[0]
-                            e_ = 2 * sub_t if r_ % sub_t else 0
+                            e_ = 0
+                            if d == sdim:
+                                sub_t = tpu_tile_dims(self.dtype)[0]
+                                e_ = 2 * sub_t if r_ % sub_t else 0
                             num *= block[d] + (K + 1) * r_ + e_
                         else:
                             num *= block[d] + 2 * rad.get(d, 0) * K

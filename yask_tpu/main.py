@@ -234,7 +234,14 @@ def run_harness(argv: Optional[List[str]] = None, out=None) -> int:
             extra={"trials": opts.num_trials,
                    "trial_steps": opts.trial_steps,
                    **({"tiling": st.get_tiling()} if st.get_tiling()
-                      else {})})
+                      else {}),
+                   # noise context for the measured halo fraction: the
+                   # relative spread across the ≥3 calibration trials
+                   # (a fraction of the same magnitude is twin jitter,
+                   # not a halo-cost change)
+                   **({"halo_cal_spread":
+                       round(st.get_halo_cal_spread(), 4)}
+                      if st.get_halo_cal_spread() > 0 else {})})
         out.write(f"ledger: recorded '{key}' "
                   f"(guard {row['guard'].get('status')})\n")
     return 0

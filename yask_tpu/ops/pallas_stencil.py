@@ -302,27 +302,40 @@ class _TileEval:
 # ---------------------------------------------------------------------------
 
 
-def skew_eligible(program, fuse_steps: int) -> bool:
-    """CAN the skewed wavefront run for this (program, K)?  Feasibility
-    only — an explicit ``skew=True`` needs just this; the auto-engage
-    decision additionally applies :func:`skew_auto_engages`' profit
-    gate."""
+def skew_eligible_dims(program, fuse_steps: int) -> List[str]:
+    """The lead dims the skewed wavefront CAN run on (lead order),
+    feasibility only.  Candidates are the innermost grid dim
+    (``lead[-1]``, consecutive sequential steps — strips carry tile to
+    tile) and the second-innermost (``lead[-2]``, one grid row back —
+    strips carry through a row-length buffer).  Deeper lead dims keep
+    the uniform shrink.  A dim qualifies when its fused radius is > 0;
+    the whole set is empty unless K ≥ 2 and every written var spans all
+    domain dims (a partial-dim write slab's slice index would become
+    pid-dependent under skewed regions)."""
     ana = program.ana
     lead = ana.domain_dims[:-1]
     if fuse_steps < 2 or not lead:
-        return False
-    # partial-dim written vars: the write-slab slice index would become
-    # pid-dependent under skewed regions — uniform shrink only
+        return []
     for g in program.geoms.values():
         if g.is_written and not g.is_scratch \
                 and g.domain_dims != ana.domain_dims:
-            return False
-    r = ana.fused_step_radius().get(lead[-1], 0)
-    return r > 0
+            return []
+    rad = ana.fused_step_radius()
+    return [d for d in lead[-2:] if rad.get(d, 0) > 0]
+
+
+def skew_eligible(program, fuse_steps: int) -> bool:
+    """CAN the skewed wavefront run at all for this (program, K)?
+    Feasibility only — an explicit ``skew=True`` needs just this; the
+    auto-engage decision additionally applies the per-dim profit gate
+    (:func:`skew_engaged_dims`)."""
+    lead = program.ana.domain_dims[:-1]
+    return bool(lead) and lead[-1] in skew_eligible_dims(
+        program, fuse_steps)
 
 
 def skew_extra_width(dtype, r: int) -> int:
-    """E_sk: the extra computed stream-dim width a skewed region needs
+    """E_sk: the extra computed sublane-dim width a skewed region needs
     when the radius is not a sublane multiple (write-back shifts round
     DOWN to the tile and the window widens by one tile; need
     E ≥ d + sub_t with d = shift−floor(shift) < sub_t ⇒ 2·sub_t).
@@ -333,39 +346,90 @@ def skew_extra_width(dtype, r: int) -> int:
     return 2 * sub_t if r % sub_t != 0 else 0
 
 
-def skew_auto_engages(program, fuse_steps: int) -> bool:
-    """Would :func:`build_pallas_chunk` auto-engage the skewed wavefront
-    (``skew=None``, single device)?  Eligibility AND the profit gate:
-    skew computes (K+1)·r + E_sk extra stream-dim width per tile vs
-    2·K·r for uniform shrink — misaligned small radii lose to their own
-    E_sk widening.  THE shared definition for the build and the HBM
-    traffic model, so bench/stats describe the tiling actually run."""
-    if not skew_eligible(program, fuse_steps):
-        return False
+def skew_extra_widths(program, fuse_steps: int) -> Dict[str, int]:
+    """Per-dim E_sk for every skew-eligible dim.  Only the stream dim
+    (``lead[-1]``) is the sublane (8-aligned-window) axis of the
+    written full-dim vars, so only it pays the rounding widening; the
+    second dim is an untiled leading DMA axis on TPU — offsets there
+    are unconstrained and its write shifts express exactly (E_sk=0)."""
     ana = program.ana
     lead = ana.domain_dims[:-1]
-    r = ana.fused_step_radius().get(lead[-1], 0)
-    e_sk = skew_extra_width(program.dtype, r)
-    return (fuse_steps + 1) * r + e_sk < 2 * fuse_steps * r
+    rad = ana.fused_step_radius()
+    out = {}
+    for d in skew_eligible_dims(program, fuse_steps):
+        out[d] = (skew_extra_width(program.dtype, rad.get(d, 0))
+                  if d == lead[-1] else 0)
+    return out
+
+
+def skew_engaged_dims(program, fuse_steps: int, unsharded=None,
+                      max_dims: int = 2) -> List[str]:
+    """The dims ``build_pallas_chunk`` auto-engages (``skew=None``),
+    lead order: eligible AND per-dim profit gate — a skewed dim
+    computes (K+1)·r + E_sk extra width per tile vs 2·K·r for uniform
+    shrink, so each dim engages independently (misaligned small stream
+    radii lose to their own E_sk widening; the second dim has E_sk=0
+    and profits whenever r > 0 at K ≥ 2).  ``unsharded`` restricts to
+    mesh-undecomposed dims (carry strips cannot cross shards); ``None``
+    = all unsharded (single device).  ``max_dims`` bounds the candidate
+    WINDOW from the innermost dim out (the ``-skew_dims`` knob): 1 =
+    the stream dim only — exactly the pre-multi-dim behavior, so the
+    1-D A/B arm never silently swaps in the outer dim.  THE shared
+    definition for the build, planner hints, and the HBM traffic
+    model, so bench/stats describe the tiling actually run."""
+    ana = program.ana
+    lead = ana.domain_dims[:-1]
+    rad = ana.fused_step_radius()
+    e_sk = skew_extra_widths(program, fuse_steps)
+    K = fuse_steps
+    if max_dims <= 0:
+        return []
+    window = lead[-max_dims:]
+    picked = []
+    for d in skew_eligible_dims(program, fuse_steps):
+        if d not in window:
+            continue
+        if unsharded is not None and d not in unsharded:
+            continue
+        r = rad.get(d, 0)
+        if (K + 1) * r + e_sk[d] < 2 * K * r:
+            picked.append(d)
+    return picked
+
+
+def skew_auto_engages(program, fuse_steps: int) -> bool:
+    """Back-compat boolean: would the STREAM dim auto-engage
+    (``skew=None``, single device)?  Same stream-dim gate as
+    :func:`skew_engaged_dims` — callers that need the full per-dim
+    decision use that directly."""
+    lead = program.ana.domain_dims[:-1]
+    return bool(lead) and lead[-1] in skew_engaged_dims(
+        program, fuse_steps)
 
 
 def skew_plan_hints(program, fuse_steps: int, engaged=None):
     """(min_block, margin_override) for :func:`plan_blocks` when the
     skewed wavefront engages — THE shared definition for the build and
-    the auto-tuner's seed plan: the stream block is floored at the
-    carry minimum (ring+1)·r, and the stream margin modeled as the
+    the auto-tuner's seed plan: each engaged dim's block is floored at
+    the carry minimum (ring+1)·r, and its margin modeled as the
     (K+1)·r + E_sk the skew actually fetches (not 2·K·r).  ``engaged``
-    overrides the auto decision (the build passes its resolved
-    use_skew, which may be an explicit skew=True).  Returns
-    (None, None) when skew won't run."""
+    overrides the auto decision: ``None`` = auto
+    (:func:`skew_engaged_dims`), ``True`` = the stream dim (the legacy
+    forced-1-D form), ``False`` = none, or an explicit list of dims
+    (the build passes its resolved skew set).  Returns (None, None)
+    when skew won't run."""
+    ana = program.ana
+    lead = ana.domain_dims[:-1]
     if engaged is None:
-        engaged = skew_auto_engages(program, fuse_steps)
+        engaged = skew_engaged_dims(program, fuse_steps)
+    elif engaged is True:
+        engaged = [lead[-1]] if lead else []
+    elif engaged is False:
+        engaged = []
     if not engaged:
         return None, None
-    ana = program.ana
-    sdim = ana.domain_dims[:-1][-1]
-    r = ana.fused_step_radius().get(sdim, 0)
-    e_sk = skew_extra_width(program.dtype, r)
+    rad = ana.fused_step_radius()
+    e_sk = skew_extra_widths(program, fuse_steps)
     ring_reads = set()
     for sr_ in program.stage_reads:
         ring_reads.update(sr_.keys())
@@ -373,8 +437,14 @@ def skew_plan_hints(program, fuse_steps: int, engaged=None):
                 for n, g in program.geoms.items()
                 if g.is_written and not g.is_scratch
                 and n in ring_reads), default=0)
-    smin = {sdim: (cv_d + 1) * r} if cv_d else None
-    return smin, {sdim: (fuse_steps + 1) * r + e_sk}
+    smin = ({d: (cv_d + 1) * rad.get(d, 0) for d in engaged}
+            if cv_d else None)
+    smarg = {d: (fuse_steps + 1) * rad.get(d, 0)
+             + e_sk.get(d, skew_extra_width(program.dtype,
+                                            rad.get(d, 0))
+                        if d == lead[-1] else 0)
+             for d in engaged}
+    return smin, smarg
 
 
 def default_vmem_budget(platform: str) -> int:
@@ -395,9 +465,11 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                        vmem_budget: int = 100 * 2 ** 20,
                        distributed: bool = False,
                        pipeline_dmas: Optional[bool] = None,
-                       skew: Optional[bool] = None,
+                       skew=None,
                        vinstr_cap: int = 300_000,
-                       stream_unsharded: bool = False):
+                       stream_unsharded: bool = False,
+                       unsharded_dims=None,
+                       max_skew_dims: int = 2):
     """Build ``chunk(state, t0) -> state`` advancing ``fuse_steps`` steps
     in one fused Pallas sweep.
 
@@ -414,24 +486,32 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     must then be the per-shard plan built with ``global_sizes`` (its
     ``global_last`` drives last_domain_index conditions).
 
-    ``skew`` selects the streaming skewed-wavefront tiling along the
-    innermost (sequential) grid dim: each fused sub-step's compute region
-    shifts left by the step radius instead of shrinking symmetrically,
-    and the inter-tile boundary strips each sub-step needs from its left
-    neighbor ride a persistent VMEM carry (double-buffered by grid
-    parity).  This removes BOTH the redundant margin recompute and the
-    2·r·K-wide halo DMA of the uniform shrink in that dim — the
-    TPU-native answer to the reference's two-phase trapezoid blocking
-    (``setup.cpp:863``, ``context.cpp:838``), whose phase coloring exists
-    to create *thread* parallelism a sequential Pallas grid does not
-    need.  ``None`` = auto: on for K ≥ 2 when the geometry is eligible
-    AND the margin model says it pays (``skew_auto_engages``).
-    Distributed chunks may skew too, but only along an UNSHARDED
-    stream dim (``stream_unsharded``): the carry then never crosses a
-    shard boundary and the radius×K ghost pads cover the skew margins
-    whenever the profit gate engages (mR = r+E_sk ≤ r·K exactly when
-    E_sk < (K−1)·r); a mesh-decomposed stream dim keeps the uniform
-    shrink.
+    ``skew`` selects the streaming skewed-wavefront tiling: in each
+    skewed grid dim a fused sub-step's compute region shifts left by the
+    step radius instead of shrinking symmetrically, and the inter-tile
+    boundary strips each sub-step needs from its already-computed
+    neighbor ride a persistent VMEM carry.  This removes BOTH the
+    redundant margin recompute and the 2·r·K-wide halo DMA of the
+    uniform shrink in that dim — the TPU-native answer to the
+    reference's multi-dim trapezoid blocking (``setup.cpp:863``,
+    ``context.cpp:838``), whose phase coloring exists to create *thread*
+    parallelism a sequential Pallas grid does not need.  Up to TWO dims
+    skew (``max_skew_dims``, the ``-skew_dims`` knob): the innermost
+    grid dim (``lead[-1]`` — consecutive sequential steps, a single
+    carry strip) and the second-innermost (``lead[-2]`` — the neighbor
+    ran one grid row earlier, so its carry buffers a whole inner row,
+    indexed by the inner program id).  The lane-minor dim always keeps
+    the uniform shrink (Mosaic 128-lane window alignment).  ``None`` =
+    auto: each eligible dim engages independently when its margin model
+    says it pays (``skew_engaged_dims``); ``True`` = force the stream
+    dim only (the legacy 1-D A/B form); a list of dims = force exactly
+    those (raising when infeasible); ``False`` = uniform shrink.
+    Distributed chunks may skew too, but only along UNSHARDED dims
+    (``unsharded_dims`` / legacy ``stream_unsharded``): the carry then
+    never crosses a shard boundary and the radius×K ghost pads cover
+    the skew margins whenever the profit gate engages (mR = r+E_sk ≤
+    r·K exactly when E_sk < (K−1)·r); mesh-decomposed dims keep the
+    uniform shrink.
     """
     import jax
     import jax.numpy as jnp
@@ -482,54 +562,86 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # carry depth per var = its ring allocation (an upper bound on how
     # many sub-steps back its levels are read).  The per-level write
     # windows shift by r per sub-step; the stream dim is the sublane
-    # (tiled) axis of every full-dim var, so HBM write windows must
+    # (tiled) axis of every full-dim var, so its HBM write windows must
     # keep 8-aligned offsets.  Sublane-multiple radii (r=8 fp32) shift
     # exactly; other radii round the shift DOWN to the sublane tile and
     # widen the window by one tile (E_sk extra computed width on the
     # right makes the widened span valid; consecutive sequential tiles
     # overwrite the sub_t-wide overlap with identical valid values).
-    skew_ok = skew_eligible(program, K)
-    R_s0 = rad.get(sdim, 0) if sdim else 0
-    E_sk_c = skew_extra_width(program.dtype, R_s0)
-    # Distributed chunks may skew only along an UNSHARDED stream dim
-    # (``stream_unsharded``, asserted by the shard planner): the carry
-    # strips then never cross a shard boundary, each shard spans the
-    # full stream extent, and the r·K ghost pads already cover the skew
-    # margins K·r (left) and r+E_sk (right, ≤ (K−1)·r whenever the
-    # profit gate engages).  This is the distributed temporal-blocking
-    # analog of the reference's rank-level wave-fronts (setup.cpp:863).
-    skew_dist_ok = not distributed or stream_unsharded
-    use_skew = skew
-    if use_skew is None:
-        # Auto-engage per the shared skew_auto_engages definition (the
-        # r4 cube-wavefront proxy regression came from engaging
-        # unprofitable misaligned small radii); explicit skew=True
-        # still forces the path for A/B measurement.
-        use_skew = skew_dist_ok and skew_auto_engages(program, K)
-    elif use_skew and (not skew_ok or not skew_dist_ok):
-        raise YaskException(
-            f"skewed wavefront needs K >= 2, an unsharded stream dim "
-            f"(carry strips cannot cross shard boundaries), a stream-dim "
-            f"radius > 0, and all written vars spanning every domain "
-            f"dim; got K={K}, distributed={distributed}, "
-            f"stream_unsharded={stream_unsharded}, "
-            f"radius={rad.get(sdim, 0) if sdim else 0}, partial-written="
-            f"{sorted(g.name for g in program.geoms.values() if g.is_written and not g.is_scratch and g.domain_dims != dims)}")
-    R_s = R_s0
+    # The second skew candidate (lead[-2]) is an untiled leading DMA
+    # axis — its shifts express exactly, E=0.
+    elig_dims = skew_eligible_dims(program, K)
+    E_all = skew_extra_widths(program, K)
+    # Distributed chunks may skew only along UNSHARDED dims (asserted
+    # by the shard planner): the carry strips then never cross a shard
+    # boundary, each shard spans those dims' full extents, and the r·K
+    # ghost pads already cover the skew margins K·r (left) and r+E_sk
+    # (right, ≤ (K−1)·r whenever the profit gate engages).  This is the
+    # distributed temporal-blocking analog of the reference's
+    # rank-level wave-fronts (setup.cpp:863).
+    if unsharded_dims is None:
+        if not distributed:
+            unsharded_dims = set(lead)
+        else:
+            unsharded_dims = ({sdim} if (stream_unsharded
+                                         and sdim is not None) else set())
+    unsharded_dims = set(unsharded_dims)
+    if isinstance(skew, (list, tuple, set, frozenset)) and not skew:
+        skew = False   # an explicit empty dim list = uniform shrink
+    forced = skew is True or isinstance(skew, (list, tuple, set,
+                                               frozenset))
+    if skew is None:
+        # Auto-engage per the shared per-dim profit gate (the r4
+        # cube-wavefront proxy regression came from engaging
+        # unprofitable misaligned small radii); explicit skew still
+        # forces the path for A/B measurement.
+        skew_dims = skew_engaged_dims(program, K,
+                                      unsharded=unsharded_dims,
+                                      max_dims=max_skew_dims)
+    elif skew is False:
+        skew_dims = []
+    elif skew is True:
+        # legacy force: the stream dim only (the 1-D-skew A/B form)
+        skew_dims = [sdim] if sdim is not None else []
+    else:
+        want = set(skew)
+        skew_dims = [d for d in lead if d in want]
+        if len(skew_dims) != len(want):
+            raise YaskException(
+                f"skew dims {sorted(want - set(skew_dims))} are not "
+                f"leading domain dims of this solution ({lead})")
+    if forced:
+        bad = [d for d in skew_dims
+               if d not in elig_dims or d not in unsharded_dims]
+        if bad or not skew_dims:
+            raise YaskException(
+                f"skewed wavefront needs K >= 2, unsharded skew dims "
+                f"(carry strips cannot cross shard boundaries), a "
+                f"radius > 0 in each skewed dim (only lead[-2:] can "
+                f"skew), and all written vars spanning every domain "
+                f"dim; got K={K}, requested={skew_dims or skew}, "
+                f"eligible={elig_dims}, distributed={distributed}, "
+                f"unsharded={sorted(unsharded_dims)}, partial-written="
+                f"{sorted(g.name for g in program.geoms.values() if g.is_written and not g.is_scratch and g.domain_dims != dims)}")
+    use_skew = bool(skew_dims)
+    skew_set = set(skew_dims)
+    R = dict(rad)
     # Misaligned (non-sublane-multiple) stream radii: every skewed
     # region carries E_sk extra computed width on its right so the
     # sublane-rounded write windows (shift floored to sub_t, size
     # +sub_t) stay inside the level's valid span: need E ≥ d + sub_t
     # with d = shift−floor(shift) < sub_t ⇒ 2·sub_t suffices.
-    E_sk = E_sk_c if use_skew else 0
-    # per-dim tile margins: uniform shrink = radius×K both sides; the
-    # skewed stream dim keeps K·r on the left (the write regions shift
-    # left by r per sub-step) but only r (+E_sk) on the right
+    E = {d: (E_all.get(d, skew_extra_width(program.dtype, R.get(d, 0))
+             if d == sdim else 0) if d in skew_set else 0)
+         for d in lead}
+    # per-dim tile margins: uniform shrink = radius×K both sides; a
+    # skewed dim keeps K·r on the left (the write regions shift left by
+    # r per sub-step) but only r (+E_sk) on the right
     mL = {d: hK[d] for d in lead}
     mR = {d: hK[d] for d in lead}
-    if use_skew:
-        mL[sdim] = K * R_s
-        mR[sdim] = R_s + E_sk
+    for d in skew_dims:
+        mL[d] = K * R[d]
+        mR[d] = R[d] + E[d]
 
     # Every var's leading-dim pads must cover the fused halo, or the DMA
     # start/end would clamp silently and corrupt results: the runtime
@@ -552,9 +664,9 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     explicit_block = block is not None
     if block is None:
         from yask_tpu.ops.tile_planner import plan_blocks
-        # carry floor + skewed stream-margin model, shared with the
+        # per-dim carry floor + skewed margin model, shared with the
         # auto-tuner's seed plan (skew_plan_hints)
-        smin, smarg = (skew_plan_hints(program, K, engaged=True)
+        smin, smarg = (skew_plan_hints(program, K, engaged=skew_dims)
                        if use_skew else (None, None))
         block = plan_blocks(program, fuse_steps=K, vmem_budget=vmem_budget,
                             vinstr_cap=vinstr_cap, min_block=smin,
@@ -584,11 +696,10 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                          if not g.is_scratch]
 
     def _gcount(d, b):
-        """Grid extent in dim d: ceil coverage; the skewed stream dim
-        needs (K−1)·r more tiles on the right because the final-level
-        write regions sit shifted left by (K−1)·r."""
-        span = sizes[d] + ((K - 1) * R_s if (use_skew and d == sdim)
-                           else 0)
+        """Grid extent in dim d: ceil coverage; each skewed dim needs
+        (K−1)·r more tiles on the right because the final-level write
+        regions sit shifted left by (K−1)·r."""
+        span = sizes[d] + ((K - 1) * R[d] if d in skew_set else 0)
         return -(-span // b)
 
     def _slab_geom(g, d, b):
@@ -632,19 +743,27 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 "pads or different block sizes")
         return b
 
+    def _fallback():
+        """Auto-engaged skew that turned out infeasible steps DOWN the
+        ladder — 2-D → 1-D → uniform — rather than failing a
+        configuration a narrower tiling still fits."""
+        return build_pallas_chunk(
+            program, fuse_steps=fuse_steps, block=block_arg,
+            interpret=interpret, vmem_budget=vmem_budget,
+            distributed=distributed, pipeline_dmas=pipeline_dmas,
+            skew=(None if len(skew_dims) >= 2 else False),
+            vinstr_cap=vinstr_cap, stream_unsharded=stream_unsharded,
+            unsharded_dims=unsharded_dims,
+            max_skew_dims=max(len(skew_dims) - 1, 0))
+
     try:
         for d in lead:
             block[d] = _fit_block(d, block[d])
     except YaskException:
-        if use_skew and skew is not True:
+        if use_skew and not forced:
             # auto-engaged skew whose wider slabs don't fit the planned
-            # pads (small misaligned radii): uniform tiling still fits
-            return build_pallas_chunk(
-                program, fuse_steps=fuse_steps, block=block_arg,
-                interpret=interpret, vmem_budget=vmem_budget,
-                distributed=distributed, pipeline_dmas=pipeline_dmas,
-                skew=False, vinstr_cap=vinstr_cap,
-                stream_unsharded=stream_unsharded)
+            # pads (small misaligned radii): narrower tilings still fit
+            return _fallback()
         raise
 
     var_order = [n for n in sorted(program.geoms)
@@ -698,19 +817,35 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     for n in var_order:
         slots[n] = len(program_state_slots(program, n))
 
-    # skewed-wavefront carry: per ring-read written var, the (D+1)·r-wide
-    # boundary strips of levels 1..K−1 that the next tile patches in,
-    # double-buffered by grid parity (tile i writes p=i%2, i+1 reads it)
+    # skewed-wavefront carry: per (skewed dim, ring-read written var),
+    # the (D+1)·r-wide boundary strips of levels 1..K−1 that the
+    # neighboring tile patches in.  Single-buffered: a level's strip is
+    # saved at the top of the LAST sub-step that patches it, AFTER the
+    # patches — so the reader's final read of a slot precedes the
+    # overwrite, and (with two skewed dims) the strip's corner cells
+    # have already received the OTHER dim's patch for that level, which
+    # is what makes the diagonal-neighbor data propagate.  The stream
+    # dim's reader is the very next sequential step (one strip); the
+    # outer dim's reader runs a whole inner row later, so its carry
+    # keeps one strip per inner-grid position.
     carry_vars = ([n for n in written if n in ring_read_vars]
                   if use_skew else [])
-    carr_base = {n: i for i, n in enumerate(carry_vars)}
+    carr_base: Dict[Tuple[str, str], int] = {}
+    for _d in skew_dims:
+        for _n in carry_vars:
+            carr_base[_d, _n] = len(carr_base)
 
-    def carry_shape(name):
+    def carry_shape(dim, name):
         shp = list(tile_shape(name))
         g = program.geoms[name]
-        ax = [i for i, (dn, _k) in enumerate(g.axes) if dn == sdim][0]
-        shp[ax] = (slots[name] + 1) * R_s
-        return (2, max(K - 1, 1)) + tuple(shp)
+        ax = [i for i, (dn, _k) in enumerate(g.axes) if dn == dim][0]
+        shp[ax] = (slots[name] + 1) * R[dim]
+        head = (max(K - 1, 1),)
+        if dim != sdim:
+            # one strip per inner-grid position (written at j =
+            # pid[-1], read back by the next row's tile at the same j)
+            head = head + (_gcount(lead[-1], block[lead[-1]]),)
+        return head + tuple(shp)
 
     def _tile_bytes():
         in_b = sum(slots[n] * int(math.prod(tile_shape(n))) * esize
@@ -721,8 +856,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                      for n in written)
         work_b += sum(int(math.prod(tile_shape(n))) * esize
                       for n in scratch_vars)
-        work_b += sum(int(math.prod(carry_shape(n))) * esize
-                      for n in carry_vars)
+        work_b += sum(int(math.prod(carry_shape(d_, n_))) * esize
+                      for (d_, n_) in carr_base)
         return in_b, work_b
 
     in_tile_bytes, work_bytes = _tile_bytes()
@@ -744,30 +879,27 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         block[d] = nb
         _plan_slabs()
         in_tile_bytes, work_bytes = _tile_bytes()
-    # Skew feasibility: the carry save-strips must come from the tile's
-    # own valid region (block[sdim] ≥ (D+1)·r, D = deepest carried
-    # ring), and the carry buffers must fit the budget alongside the
-    # tiles.  Auto-engaged skew falls back to the uniform tiling rather
-    # than failing a configuration that tiling still fits.
+    # Skew feasibility: each skewed dim's carry save-strips must come
+    # from the tile's own valid region (block[d] ≥ (D+1)·r, D = deepest
+    # carried ring), and the carry buffers must fit the budget
+    # alongside the tiles.  Auto-engaged skew steps down the ladder
+    # (2-D → 1-D → uniform) rather than failing a configuration a
+    # narrower tiling still fits.
     if use_skew:
         d_max = max((slots[n] for n in carry_vars), default=0)
-        infeasible = (carry_vars
-                      and block[sdim] < (d_max + 1) * R_s) or \
+        infeasible = any(carry_vars and block[d] < (d_max + 1) * R[d]
+                         for d in skew_dims) or \
             (in_tile_bytes + work_bytes > vmem_budget)
         if infeasible:
-            if skew:   # explicitly requested: surface the constraint
+            if forced:   # explicitly requested: surface the constraint
                 raise YaskException(
-                    f"skewed wavefront needs block[{sdim}] >= "
-                    f"{(d_max + 1) * R_s} (ring {d_max} × radius "
-                    f"{R_s}) and carry within the VMEM budget; got "
-                    f"block {block[sdim]}, "
+                    f"skewed wavefront needs block[d] >= "
+                    f"{[(d, (d_max + 1) * R[d]) for d in skew_dims]} "
+                    f"(ring {d_max} × radius) and carry within the "
+                    f"VMEM budget; got "
+                    f"block {[(d, block[d]) for d in skew_dims]}, "
                     f"{(in_tile_bytes + work_bytes)/2**20:.1f} MiB")
-            return build_pallas_chunk(
-                program, fuse_steps=fuse_steps, block=block_arg,
-                interpret=interpret, vmem_budget=vmem_budget,
-                distributed=distributed, pipeline_dmas=pipeline_dmas,
-                skew=False, vinstr_cap=vinstr_cap,
-                stream_unsharded=stream_unsharded)
+            return _fallback()
 
     tile_bytes = in_tile_bytes + work_bytes
     if tile_bytes > vmem_budget:
@@ -851,8 +983,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         n_tiles = sum(slots[n] for n in dma_vars)
         scratch = refs[n_inputs + nout:n_inputs + nout + n_tiles]
         _cb = n_inputs + nout + n_tiles
-        carr = refs[_cb:_cb + len(carry_vars)]
-        ostage = refs[_cb + len(carry_vars):-2]
+        carr = refs[_cb:_cb + len(carr_base)]
+        ostage = refs[_cb + len(carr_base):-2]
         sem = refs[-2]
         out_sem = refs[-1]
 
@@ -896,21 +1028,27 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                         if kind == "misc" or dn == minor:
                             src_idxs.append(slice(None))
                             dst_idxs.append(slice(None))
-                        elif use_skew and dn == sdim:
+                        elif dn in skew_set:
                             # level lvl's write region sits shifted left
-                            # by (lvl−1)·r.  Sublane-multiple shifts
-                            # express exactly; others round the shift
-                            # DOWN to the sublane tile and widen the
-                            # window by one tile: both ends stay inside
-                            # the level's valid span (E_sk budgeted it),
-                            # and the sub_t overlap with the next
-                            # sequential tile re-writes identical valid
-                            # values (src and dst starts share the same
-                            # residue, g.origin ≡ mL+resid (mod 8)).
-                            shift = (lvl - 1) * R_s
-                            sh_al = (shift // sub_t) * sub_t
-                            wsz = block[dn] + (sub_t if sh_al != shift
-                                               else 0)
+                            # by (lvl−1)·r.  On the var's sublane axis,
+                            # sublane-multiple shifts express exactly;
+                            # others round the shift DOWN to the sublane
+                            # tile and widen the window by one tile:
+                            # both ends stay inside the level's valid
+                            # span (E_sk budgeted it), and the sub_t
+                            # overlap with the next sequential tile
+                            # re-writes identical valid values (src and
+                            # dst starts share the same residue,
+                            # g.origin ≡ mL+resid (mod 8)).  Outer skew
+                            # dims are untiled leading DMA axes: the
+                            # shift expresses exactly.
+                            shift = (lvl - 1) * R[dn]
+                            if _sub_dim(g) == dn:
+                                sh_al = (shift // sub_t) * sub_t
+                                wsz = block[dn] + (sub_t if sh_al != shift
+                                                   else 0)
+                            else:
+                                sh_al, wsz = shift, block[dn]
                             src_idxs.append(pl.ds(
                                 mL[dn] - sh_al + resid[name, dn], wsz))
                             dst_idxs.append(pl.ds(
@@ -1098,38 +1236,49 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
 
         # ---- skewed-wavefront carry helpers -------------------------
         # Sub-step s writes W_s = [i·B − (s−1)·r, i·B + B − (s−1)·r) in
-        # the stream dim; reading level ℓ at sub-step s needs [W_s.lo −
+        # a skewed dim; reading level ℓ at sub-step s needs [W_s.lo −
         # r, …) — below this tile's own computed span.  Those cells are
-        # the previous tile's freshly-computed right edge: it saved them
-        # into the parity carry, and this tile patches them in before
+        # the neighboring tile's freshly-computed right edge: it saved
+        # them into the carry, and this tile patches them in before
         # each sub-step (width 2r for a level's first patch — its
         # computed validity starts 2r right of the read edge — then r
         # per later sub-step while it stays live; (D+1)·r total).
-        def _strip_idx(name, lo, width):
+        # Single-buffered with a DELAYED save: level ℓ's strip is
+        # stored at the top of sub-step min(ℓ+D−1, K−1) — after that
+        # sub-step's patches, i.e. after the reader's LAST read of the
+        # slot (so no parity double-buffer is needed) and after the
+        # OTHER skewed dim's level-ℓ patch landed in this tile (so the
+        # strip's corner cells carry the diagonal neighbor's data —
+        # the 2-D correctness requirement).
+        def _strip_idx(name, dim, lo, width):
             g = program.geoms[name]
             shp = tile_shape(name)
             idxs = []
             for i, (dn, kind) in enumerate(g.axes):
-                if kind == "domain" and dn == sdim:
+                if kind == "domain" and dn == dim:
                     rs_ = resid.get((name, dn), 0)
                     idxs.append(slice(rs_ + lo, rs_ + lo + width))
                 else:
                     idxs.append(slice(0, shp[i]))
             return tuple(idxs)
 
-        def _carry_idx(name, lvl, off, width, par):
+        def _carry_idx(name, dim, lvl, off, width):
             g = program.geoms[name]
-            idxs = [par, lvl - 1]
+            idxs = [lvl - 1]
+            if dim != sdim:
+                # the outer dim's carry holds one strip per inner-grid
+                # position; the reader (next row, same position) indexes
+                # the same traced slot
+                idxs.append(pid[-1])
             for dn, kind in g.axes:
-                if kind == "domain" and dn == sdim:
+                if kind == "domain" and dn == dim:
                     idxs.append(slice(off, off + width))
                 else:
                     idxs.append(slice(None))
             return tuple(idxs)
 
         if use_skew and carry_vars:
-            spid = pid[-1]
-            wpar0 = (spid % 2) == 0    # this tile writes carry buf 0
+            pid_d = {d: pid[lead.index(d)] for d in skew_dims}
 
         for k in range(K):
             computed: Dict[str, object] = {}
@@ -1137,46 +1286,68 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             consumed = {d: rad[d] * k for d in lead}
             ev.t = t0_ref[0] + k * dirn
 
-            # patch the live ring levels' left strips from the previous
-            # tile's carry before computing sub-step k+1
+            # patch the live ring levels' left strips from the
+            # neighboring tiles' carries before computing sub-step k+1
             if use_skew and carry_vars and k >= 1:
-                for n in carry_vars:
-                    Dn = slots[n]
-                    ring = tiles[n]
-                    for j in range(len(ring)):
-                        lvl = k - (len(ring) - 1 - j)
-                        if lvl < 1:
-                            continue
-                        width = (2 if lvl == k else 1) * R_s
-                        lo = (K - k - 1) * R_s
-                        coff = (lvl + Dn - k - 1) * R_s
-                        cref = carr[carr_base[n]]
-                        s0 = cref[_carry_idx(n, lvl, coff, width, 0)]
-                        s1 = cref[_carry_idx(n, lvl, coff, width, 1)]
-                        # reader parity = writer tile (spid−1)'s parity
-                        strip = jnp.where(wpar0, s1, s0)
-                        # row start: the left margin is out-of-domain
-                        # ghost (single-device skew only) — zero
-                        strip = jnp.where(spid > 0, strip,
-                                          jnp.zeros_like(strip))
-                        ring[j] = tile_update(
-                            ring[j], _strip_idx(n, lo, width), strip)
+                for dim in skew_dims:
+                    for n in carry_vars:
+                        Dn = slots[n]
+                        ring = tiles[n]
+                        for j in range(len(ring)):
+                            lvl = k - (len(ring) - 1 - j)
+                            if lvl < 1:
+                                continue
+                            width = (2 if lvl == k else 1) * R[dim]
+                            lo = (K - k - 1) * R[dim]
+                            coff = (lvl + Dn - k - 1) * R[dim]
+                            cref = carr[carr_base[dim, n]]
+                            strip = cref[_carry_idx(n, dim, lvl, coff,
+                                                    width)]
+                            # dim start: the left margin is
+                            # out-of-domain ghost (and for the outer
+                            # dim, pid 0 also marks a fresh row whose
+                            # stale strips must not leak) — zero
+                            strip = jnp.where(pid_d[dim] > 0, strip,
+                                              jnp.zeros_like(strip))
+                            ring[j] = tile_update(
+                                ring[j], _strip_idx(n, dim, lo, width),
+                                strip)
+                # delayed saves: store every level whose last patch was
+                # this sub-step's (above) — reads precede the overwrite
+                for dim in skew_dims:
+                    for n in carry_vars:
+                        Dn = slots[n]
+                        ring = tiles[n]
+                        if k < K - 1:
+                            lvls = ([k - Dn + 1] if k - Dn + 1 >= 1
+                                    else [])
+                        else:
+                            lvls = list(range(max(1, K - Dn), K))
+                        for lvl in lvls:
+                            j = Dn - 1 - (k - lvl)
+                            lo = block[dim] + (K - lvl - Dn) * R[dim]
+                            width = (Dn + 1) * R[dim]
+                            strip = ring[j][_strip_idx(n, dim, lo,
+                                                       width)]
+                            cref = carr[carr_base[dim, n]]
+                            cref[_carry_idx(n, dim, lvl, 0, width)] = \
+                                strip
 
             for si_stage in range(nstages):
                 for d in lead:
                     consumed[d] += stage_r[si_stage][d]
                 region = []
                 for d in lead:
-                    if use_skew and d == sdim:
+                    if d in skew_set:
                         # skew: fixed-width region sliding left by r per
                         # sub-step; stages still consume their margins.
                         # E_sk extra right width (misaligned radii) rides
                         # every region so the telescoping validity spans
                         # keep covering the widened write windows.
                         c_stage = consumed[d] - rad[d] * k
-                        lo = mL[d] - (k + 1) * R_s + c_stage
+                        lo = mL[d] - (k + 1) * R[d] + c_stage
                         region.append((lo, lo + block[d]
-                                       + 2 * (R_s - c_stage) + E_sk))
+                                       + 2 * (R[d] - c_stage) + E[d]))
                     else:
                         region.append((consumed[d],
                                        block[d] + mL[d] + mR[d]
@@ -1300,29 +1471,6 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 else:
                     tiles[name] = [newest]
 
-            # save this level's right-edge strip for the next tile
-            # (level k+1; levels 1..K−1 are ever patched)
-            if use_skew and carry_vars and k + 1 <= K - 1:
-                for n in carry_vars:
-                    Dn = slots[n]
-                    lo = block[sdim] + (K - (k + 1) - Dn) * R_s
-                    width = (Dn + 1) * R_s
-                    strip = tiles[n][-1][_strip_idx(n, lo, width)]
-                    cref = carr[carr_base[n]]
-
-                    def _store(cref=cref, n=n, k=k, width=width,
-                               strip=strip):
-                        @pl.when(wpar0)
-                        def _w0():
-                            cref[_carry_idx(n, k + 1, 0, width, 0)] = \
-                                strip
-
-                        @pl.when(jnp.logical_not(wpar0))
-                        def _w1():
-                            cref[_carry_idx(n, k + 1, 0, width, 1)] = \
-                                strip
-                    _store()
-
         # 3) write back the slots the K sub-steps actually produced (the
         #    newest min(K, alloc)); untouched older slots merely shifted
         #    and are rebuilt host-side from the existing padded inputs.
@@ -1397,8 +1545,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 shp = (2,) + shp
             scratch_shapes.append(pltpu.VMEM(shp, dtype))
     # skewed-wavefront carry strips persist across the sequential grid
-    for n in carry_vars:
-        scratch_shapes.append(pltpu.VMEM(carry_shape(n), dtype))
+    for (d_, n_) in carr_base:
+        scratch_shapes.append(pltpu.VMEM(carry_shape(d_, n_), dtype))
     # dedicated parity-doubled output staging (pipelined write-back)
     if use_pipe_out:
         for name in written:
@@ -1494,16 +1642,18 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 _cons[d] += stage_r[_si][d]
             _v = _u = 1
             for d in lead:
-                if use_skew and d == sdim:
+                if d in skew_set:
                     _cst = _cons[d] - rad[d] * _k
-                    _v *= block[d] + 2 * (R_s - _cst) + E_sk
+                    _v *= block[d] + 2 * (R[d] - _cst) + E[d]
                 else:
                     _v *= block[d] + mL[d] + mR[d] - 2 * _cons[d]
                 _u *= block[d]
             _computed += _v
             _useful += _u
     chunk.tiling = {"fuse_steps": K, "block": dict(block),
-                    "skew": bool(use_skew), "pipeline_dmas": use_pipe,
+                    "skew": bool(use_skew),
+                    "skew_dims": list(skew_dims),
+                    "pipeline_dmas": use_pipe,
                     "pipeline_out": use_pipe_out,
                     "tile_bytes": tile_bytes,
                     "margin_overhead":

@@ -44,10 +44,15 @@ def plan_blocks(program, fuse_steps: int = 1,
 
     ``margin_override`` replaces the default uniform ``2·r·K`` TOTAL
     tile margin per dim in the VMEM/overhead/vinstr models — the build
-    passes the skewed stream dim's ``(K+1)·r + E_sk`` so the planner
-    does not leave budget on the table modeling margins the skew never
-    fetches (at 512³ r=8 K=2 this is the difference between 8-wide and
-    16-wide x blocks).
+    passes each skewed dim's ``(K+1)·r + E_sk`` so the planner does not
+    leave budget on the table modeling margins the skew never fetches
+    (at 512³ r=8 K=2 this is the difference between 8-wide and 16-wide
+    x blocks; with both dims skewed the margin shrinks in x AND y).
+
+    ``min_block`` floors (the skew carry needs blocks ≥ (ring+1)·r in
+    every skewed dim) are applied AFTER the initial divisor snap and
+    themselves snap UP to the next divisor, so a non-divisor carry
+    floor still yields a block ≥ the floor (never silently below it).
     """
     ana = program.ana
     dims = ana.domain_dims

@@ -307,9 +307,23 @@ def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
                 break
         return (time.perf_counter() - t0) / calls
 
-    t_no = timed(fn_no)
-    t_ex = timed(fn)
+    def timed_median(f, trials=3):
+        """Median of ≥3 independent timed trials + their relative
+        spread ((max−min)/median).  The halo fraction is a (real −
+        twin) subtraction of two short samples, so a single outlier
+        trial (GC pause, co-tenant burst) lands directly in the
+        reported fraction; the median rejects it and the recorded
+        spread says how much the twin wandered — rows whose spread
+        rivals the fraction itself are not evidence of anything."""
+        samples = sorted(timed(f) for _ in range(trials))
+        med = samples[len(samples) // 2]
+        spread = (samples[-1] - samples[0]) / med if med > 0 else 0.0
+        return med, spread
+
+    t_no, sp_no = timed_median(fn_no)
+    t_ex, sp_ex = timed_median(fn)
     ctx._halo_frac[key] = max(0.0, 1.0 - t_no / t_ex) if t_ex > 0 else 0.0
+    ctx._halo_cal_spread[key] = max(sp_no, sp_ex)
     if fn_xonly is not None:
         ctx._halo_xround[key] = timed(fn_xonly)
     if fn_pack is not None:
@@ -603,6 +617,7 @@ def run_shard_map(ctx, start: int, n: int) -> None:
         frac = ctx._halo_frac[key]
         ctx._halo_xround_last = ctx._halo_xround.get(key, 0.0)
         ctx._halo_xpack_last = ctx._halo_xpack.get(key, 0.0)
+        ctx._halo_cal_spread_last = ctx._halo_cal_spread.get(key, 0.0)
         cal_secs = time.perf_counter() - t0cal
 
     t0c2 = time.perf_counter()
@@ -681,21 +696,25 @@ def _prep_shard_pallas(ctx, n: int, K: int, blk):
     # temporal-tiling analog of the reference's update_tb_info,
     # setup.cpp:863).
     lead_local = dims[:-1]
-    sdim = lead_local[-1] if lead_local else None
-    stream_unsharded = sdim is not None and nr.get(sdim, 1) == 1
+    # per-dim: each skewed dim's carry must stay on-shard, so a dim may
+    # engage exactly when it is not mesh-decomposed (the r·K ghost pads
+    # then cover its skew margins)
+    unsh = tuple(d for d in lead_local if nr.get(d, 1) == 1)
     skw = None if ctx._opts.skew_wavefront else False
     chunk, tile_bytes = build_pallas_chunk(
         local_prog, fuse_steps=K, block=blk, interpret=interp,
         distributed=True, vmem_budget=budget,
         vinstr_cap=ctx._opts.max_tile_vinstr, skew=skw,
-        stream_unsharded=stream_unsharded)
+        unsharded_dims=unsh,
+        max_skew_dims=ctx._opts.skew_dims_max)
     chunk_rem = None
     if rem:
         chunk_rem, _ = build_pallas_chunk(
             local_prog, fuse_steps=rem, block=blk, interpret=interp,
             distributed=True, vmem_budget=budget,
             vinstr_cap=ctx._opts.max_tile_vinstr, skew=skw,
-            stream_unsharded=stream_unsharded)
+            unsharded_dims=unsh,
+            max_skew_dims=ctx._opts.skew_dims_max)
     ctx._env.trace_msg(
         f"shard_pallas chunk: K={K}, blocks={blk or 'planner'}, "
         f"tile {tile_bytes / 2**20:.2f} MiB, "
@@ -826,8 +845,8 @@ def get_shard_pallas_fn(ctx, interior, start: int, n: int, K: int, blk,
     ``YaskException`` for infeasible candidates."""
     import jax
     import jax.numpy as jnp
-    skw = None if ctx._opts.skew_wavefront else False
-    key = ("shard_pallas", n, K, blk, skw)
+    var = ctx._pallas_variant_key()
+    key = ("shard_pallas", n, K, blk) + var
     if key not in ctx._jit_cache:
         if build is None:
             _, _, build = _prep_shard_pallas(ctx, n, K, blk)
@@ -838,7 +857,7 @@ def get_shard_pallas_fn(ctx, interior, start: int, n: int, K: int, blk,
         ctx._compile_secs += time.perf_counter() - t0c
         # only after a successful compile (see _prep_shard_pallas)
         if getattr(build, "tiling", None) is not None:
-            ctx._pallas_tiling[("shard_pallas", K, blk, skw)] = \
+            ctx._pallas_tiling[("shard_pallas", K, blk) + var] = \
                 build.tiling
     return ctx._jit_cache[key]
 
@@ -878,8 +897,7 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
     blk = None
     if any(bs[d] > 0 for d in dims[:-1]):
         blk = tuple(bs[d] if bs[d] > 0 else 8 for d in dims[:-1])
-    key = ("shard_pallas", n, K, blk,
-           None if opts.skew_wavefront else False)
+    key = ("shard_pallas", n, K, blk) + ctx._pallas_variant_key()
 
     need_build = key not in ctx._jit_cache
     need_cal = (opts.measure_halo_time and key not in ctx._halo_frac)
@@ -939,6 +957,7 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
         frac = ctx._halo_frac[key]
         ctx._halo_xround_last = ctx._halo_xround.get(key, 0.0)
         ctx._halo_xpack_last = ctx._halo_xpack.get(key, 0.0)
+        ctx._halo_cal_spread_last = ctx._halo_cal_spread.get(key, 0.0)
 
     ctx._resident = None   # interior buffers are donated next; any
     #                          failure before this point kept them valid

@@ -71,18 +71,21 @@ def format_roofline(roof: Dict) -> str:
 def vmem_sweep_margin_model(stencil: str = "iso3dfd", radius: int = 8,
                             g: int = 512, fuse_steps: int = 2,
                             budgets_mib=(64, 96, 120),
-                            dtype_bytes: Optional[int] = None) -> Dict:
+                            dtype_bytes: Optional[int] = None,
+                            max_skew_dims: int = 2) -> Dict:
     """Modeled (block, margin_overhead) per VMEM budget — the relay-down
-    variant of the ``-vmem_mb`` hardware sweep (VERDICT r5 item 7): runs
-    the actual tile planner + margin model on the CPU, no backend
-    needed.  Returns {budget_mib: {"block": {...},
-    "margin_overhead": f}}.
+    variant of the ``-vmem_mb`` hardware sweep (VERDICT r5 item 7) and
+    the model behind the auto-tuner's vmem ladder: runs the actual tile
+    planner + margin model on the CPU, no backend needed.  Returns
+    {budget_mib: {"block": {...}, "margin_overhead": f}}.
 
     The numbers come from the ACTUAL kernel build (``build_pallas_chunk``
     in interpret mode — planning + tracing setup only, nothing runs):
     ``chunk.tiling`` is the same exact per-(sub-step, stage) accounting
     a hardware run would report, so the modeled table and a later
-    measured one are directly comparable.
+    measured one are directly comparable.  ``max_skew_dims`` mirrors
+    the ``-skew_dims`` knob (2 = multi-dim skew allowed; 1 = the 1-D
+    A/B arm); each row records which dims actually engaged.
     """
     from yask_tpu.compiler.solution_base import create_solution
     from yask_tpu.ops.pallas_stencil import build_pallas_chunk
@@ -101,10 +104,12 @@ def vmem_sweep_margin_model(stencil: str = "iso3dfd", radius: int = 8,
     for mib in budgets_mib:
         chunk, tile_bytes = build_pallas_chunk(
             prog, fuse_steps=K, interpret=True,
-            vmem_budget=int(mib) * 2 ** 20)
+            vmem_budget=int(mib) * 2 ** 20,
+            max_skew_dims=max_skew_dims)
         t = chunk.tiling
         out[int(mib)] = {
             "block": dict(t["block"]), "skew": t["skew"],
+            "skew_dims": list(t.get("skew_dims", [])),
             "margin_overhead": t["margin_overhead"],
             "tile_mib": round(tile_bytes / 2 ** 20, 1),
         }

@@ -86,6 +86,16 @@ ISO3DFD_128_JIT_FLOOR = 0.052
 #: cross-host variance.
 CUBE_WAVEFRONT_FLOOR = 1.5
 
+#: PROVISIONAL floor for the 2-D-vs-1-D skew speedup ratio
+#: (bench_suite ``skew2d-speedup``).  No hardware history yet (relay
+#: down since r4); the failure class it guards is the r4 cube lesson
+#: one dim up — the outer-dim carry mis-engaging and HALVING the rate
+#: instead of helping.  0.75 flags a halving-class slide while
+#: tolerating the CPU proxy's margin-model inversion (interpret-mode
+#: carries are copies, not DMA savings).  Re-base from clean TPU rows
+#: once tpu_session banks them.
+SKEW2D_SPEEDUP_FLOOR = 0.75
+
 DEFAULT_RULES: List[GuardRule] = [
     GuardRule(name="iso3dfd-128-jit-floor",
               pattern="128^3 fp32 cpu throughput",
@@ -94,6 +104,9 @@ DEFAULT_RULES: List[GuardRule] = [
     GuardRule(name="cube-wavefront-floor",
               pattern="wavefront-speedup",
               floor=CUBE_WAVEFRONT_FLOOR, rel_tol=0.25),
+    GuardRule(name="skew2d-speedup-floor",
+              pattern="skew2d-speedup",
+              floor=SKEW2D_SPEEDUP_FLOOR, rel_tol=0.25),
     # the backstop every throughput/speedup row gets: trailing clean
     # median, generous tolerance (CPU-proxy trial noise is real)
     GuardRule(name="trailing-median", rel_tol=0.35),

@@ -27,6 +27,16 @@ class AutoTuner:
     #: chunk-length candidates for the K-only sweep (jit/sharded modes).
     CHUNK_CANDIDATES = (1, 2, 4, 8, 16, 32)
 
+    #: VMEM-budget rungs (MiB) the joint walks sweep as an OUTER tuning
+    #: axis when ``-vmem_mb`` is 0 (auto) and ``-tune_vmem_ladder`` is
+    #: on. 64 is the conservative planning default (Mosaic live SSA
+    #: values roughly double tile usage); v5e's scoped limit probed
+    #: ≥120 MiB, so the upper rungs admit wider blocks (at 512³ r=8 K=2
+    #: the 64→96 step is the difference between 8×32 and 16×32 x-blocks)
+    #: while Mosaic VMEM OOMs on over-eager rungs are caught as
+    #: infeasible candidates, never fatal.
+    VMEM_LADDER_MIB = (64, 96, 120)
+
     def __init__(self, ctx):
         self.ctx = ctx
         self.results: Dict[Tuple, float] = {}   # candidate → secs/step
@@ -140,8 +150,19 @@ class AutoTuner:
             # failures re-raise so an outage stays loud instead of
             # ending the walk "successfully" with all-inf results.
             msg = f"{type(e).__name__}: {e}"
-            if ("RESOURCE_EXHAUSTED" in msg or "vmem" in msg.lower()
-                    or "Mosaic" in msg or "INTERNAL" in msg
+            if "RESOURCE_EXHAUSTED" in msg or "vmem" in msg.lower():
+                # A Mosaic VMEM OOM (register-spill slots over
+                # vmem_limit_bytes) is a *genuinely infeasible
+                # candidate*, not an outage symptom: it never counts
+                # toward the consecutive-failure breaker, so the vmem
+                # ladder's ambitious rungs can strike out on dense
+                # kernels without ending the walk.
+                self.ctx._env.trace_msg(
+                    f"auto-tuner: candidate {key} exceeded VMEM "
+                    f"({msg[:160]}); marking infeasible")
+                self.results[key] = float("inf")
+                return float("inf")
+            if ("Mosaic" in msg or "INTERNAL" in msg
                     or "tpu_compile" in msg):
                 self._consec_fails = getattr(self, "_consec_fails", 0) + 1
                 if self._consec_fails >= 3:
@@ -261,6 +282,49 @@ class AutoTuner:
         cur, cur_rate = walk_from(cur, cur_rate, refine)
         return cur, cur_rate
 
+    def _ladder_rungs(self) -> List[int]:
+        """VMEM-budget rungs for the joint walks: the full ladder when
+        the budget is auto (``-vmem_mb 0``) and ``-tune_vmem_ladder`` is
+        on, else just the configured budget (a single rung — the walk
+        runs exactly as before)."""
+        opts = self.ctx._opts
+        if opts.vmem_budget_mb == 0 and getattr(
+                opts, "tune_vmem_ladder", False):
+            return list(self.VMEM_LADDER_MIB)
+        return [opts.vmem_budget_mb]
+
+    def _walk_ladder(self, walk_one, lead) -> int:
+        """Outer vmem-budget loop shared by both joint walks.
+
+        ``walk_one(mb, ladder)`` runs one full (K, block) walk with
+        ``ctx._opts.vmem_budget_mb`` temporarily set to ``mb`` and
+        returns ``(cur, cur_rate)``; measure keys gain the budget
+        element only when laddering so single-rung behavior (and every
+        existing test's key shapes) is unchanged. The winning rung is
+        applied into ``vmem_budget_mb`` alongside ``_finish_joint`` so
+        production compiles — and ``apply_best`` replays — use it."""
+        ctx = self.ctx
+        rungs = self._ladder_rungs()
+        ladder = len(rungs) > 1
+        saved_mb = ctx._opts.vmem_budget_mb
+        outcomes = []
+        try:
+            for mb in rungs:
+                ctx._opts.vmem_budget_mb = mb
+                cur, cur_rate = walk_one(mb, ladder)
+                outcomes.append((cur_rate, mb, cur))
+                if ladder:
+                    ctx._env.trace_msg(
+                        f"auto-tuner: vmem rung {mb} MiB -> "
+                        f"{cur} ({cur_rate * 1e3:.3f} ms/step)")
+        finally:
+            ctx._opts.vmem_budget_mb = saved_mb
+        cur_rate, mb, cur = min(outcomes, key=lambda t: t[0])
+        if ladder and cur_rate != float("inf"):
+            ctx._opts.vmem_budget_mb = mb
+            ctx._env.trace_msg(f"auto-tuner: vmem budget {mb} MiB wins")
+        return self._finish_joint(cur, cur_rate, lead)
+
     def _start_point(self, k0):
         """Planner-informed starting (K, blocks) for the joint walk."""
         from yask_tpu.ops.tile_planner import plan_blocks
@@ -273,16 +337,24 @@ class AutoTuner:
             # seed with the same carry-floor + skewed-margin hints the
             # build's default plan uses, or the walk wastes trials
             # re-discovering the build's own block shape.  shard_pallas
-            # with a mesh-decomposed stream dim never skews
-            # (stream_unsharded=False in shard_step), so the seed must
-            # model uniform margins there — same guard as the HBM model.
-            from yask_tpu.ops.pallas_stencil import skew_plan_hints
-            skew_possible = ctx._opts.skew_wavefront
-            if skew_possible and ctx._opts.mode == "shard_pallas" \
-                    and lead and ctx._opts.num_ranks[lead[-1]] > 1:
-                skew_possible = False
-            smin, smarg = ((None, None) if not skew_possible
-                           else skew_plan_hints(ctx._program, k0))
+            # engages skew per dim only where that dim is unsharded
+            # (the carry cannot cross shards), so the seed must model
+            # uniform margins in the sharded dims — same per-dim guard
+            # as the HBM model.
+            from yask_tpu.ops.pallas_stencil import (
+                skew_engaged_dims, skew_plan_hints)
+            smin, smarg = None, None
+            if ctx._opts.skew_wavefront:
+                unsh = None
+                if ctx._opts.mode == "shard_pallas":
+                    unsh = [d for d in lead
+                            if ctx._opts.num_ranks[d] <= 1]
+                engaged = skew_engaged_dims(
+                    ctx._program, k0, unsharded=unsh,
+                    max_dims=ctx._opts.skew_dims_max)
+                if engaged:
+                    smin, smarg = skew_plan_hints(ctx._program, k0,
+                                                  engaged=engaged)
             planned = plan_blocks(ctx._program, fuse_steps=k0,
                                   vmem_budget=ctx.vmem_budget(),
                                   vinstr_cap=ctx._opts.max_tile_vinstr,
@@ -319,23 +391,26 @@ class AutoTuner:
         k0 = max(ctx._opts.wf_steps, 1)
         kmax = max(ctx._opts.tune_max_wf_steps, k0)
 
-        def measure(cand):
-            k, blk = cand
+        def walk_one(mb, ladder):
+            def measure(cand):
+                k, blk = cand
 
-            def mk():
-                old = {d: bs[d] for d in lead}
-                for d, b in zip(lead, blk):
-                    bs[d] = b
-                try:
-                    return ctx._get_pallas_chunk(k)
-                finally:
-                    for d in lead:
-                        bs[d] = old[d]
-            return self._measure((k, blk), mk)
+                def mk():
+                    old = {d: bs[d] for d in lead}
+                    for d, b in zip(lead, blk):
+                        bs[d] = b
+                    try:
+                        return ctx._get_pallas_chunk(k)
+                    finally:
+                        for d in lead:
+                            bs[d] = old[d]
+                key = (k, blk, mb) if ladder else (k, blk)
+                return self._measure(key, mk, k=k)
 
-        cur, cur_rate = self._walk(measure, k0, self._start_point(k0),
-                                   sizes, lead, kmax)
-        return self._finish_joint(cur, cur_rate, lead)
+            return self._walk(measure, k0, self._start_point(k0),
+                              sizes, lead, kmax)
+
+        return self._walk_ladder(walk_one, lead)
 
     def _walk_joint_shard(self, candidates=None) -> int:
         """Joint (K, block-shape) walk for the distributed shard_pallas
@@ -370,22 +445,27 @@ class AutoTuner:
         # buffers) alive for the context's lifetime buys nothing.
         keys_before = set(ctx._jit_cache)
 
-        def measure(cand):
-            k, blk = cand
+        def make_measure(mb=None, ladder=False):
+            def measure(cand):
+                k, blk = cand
 
-            def mk():
-                return get_shard_pallas_fn(ctx, trial, t_trial,
-                                           n=k, K=k, blk=blk)
+                def mk():
+                    return get_shard_pallas_fn(ctx, trial, t_trial,
+                                               n=k, K=k, blk=blk)
 
-            def call(fn):
-                # The donated input is exactly the previous call's
-                # output, so no per-call copy is needed.
-                nonlocal trial, t_trial
-                st = fn(trial, jnp.asarray(t_trial, dtype=jnp.int32))
-                jax.block_until_ready(st)
-                trial = st
-                t_trial += k * dirn
-            return self._measure(("sp", k, blk), mk, call=call, k=k)
+                def call(fn):
+                    # The donated input is exactly the previous call's
+                    # output, so no per-call copy is needed.
+                    nonlocal trial, t_trial
+                    st = fn(trial, jnp.asarray(t_trial, dtype=jnp.int32))
+                    jax.block_until_ready(st)
+                    trial = st
+                    t_trial += k * dirn
+                key = (("sp", k, blk, mb) if ladder else ("sp", k, blk))
+                return self._measure(key, mk, call=call, k=k)
+            return measure
+
+        measure = make_measure()
 
         try:
             if candidates is not None:
@@ -410,9 +490,12 @@ class AutoTuner:
                     return ctx._opts.wf_steps
                 return self._finish_joint(best_key, best, lead)
 
-            cur, cur_rate = self._walk(measure, k0, self._start_point(k0),
-                                       sizes, lead, kmax)
-            return self._finish_joint(cur, cur_rate, lead)
+            def walk_one(mb, ladder):
+                return self._walk(make_measure(mb, ladder), k0,
+                                  self._start_point(k0), sizes, lead,
+                                  kmax)
+
+            return self._walk_ladder(walk_one, lead)
         finally:
             for key in set(ctx._jit_cache) - keys_before:
                 if key[0] == "shard_pallas":
@@ -431,3 +514,7 @@ class AutoTuner:
             lead = self.ctx._ana.domain_dims[:-1]
             for d, b in zip(lead, best[1]):
                 self.ctx._opts.block_sizes[d] = b
+        if len(best) > 2 and best[2] is not None:
+            # vmem-ladder result: pin the winning budget so replays
+            # compile with the rung the measurement actually used
+            self.ctx._opts.vmem_budget_mb = best[2]

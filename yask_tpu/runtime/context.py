@@ -301,21 +301,7 @@ class StencilContext:
                 # zero-filled and cheap; without this every K-doubling
                 # candidate fails pad validation and caches as inf).
                 K = max(K, self._opts.tune_max_wf_steps)
-            step_rad = self._ana.fused_step_radius()
-            lead = self._ana.domain_dims[:-1]
-            for d in lead:
-                need = step_rad.get(d, 0) * K
-                need_r = need
-                if d == lead[-1] and self._opts.skew_wavefront:
-                    # Misaligned (non-sublane-multiple) stream radii:
-                    # the skewed tiling computes E_sk extra right width
-                    # and its widened slabs need the same again in
-                    # rounding room (single E_sk definition:
-                    # pallas_stencil.skew_extra_width).
-                    from yask_tpu.ops.pallas_stencil import \
-                        skew_extra_width
-                    need_r = need + 2 * skew_extra_width(
-                        self._csol.dtype, step_rad.get(d, 0))
+            for d, (need, need_r) in self._pallas_pad_needs(K).items():
                 l, r = extra[d]
                 extra[d] = (max(l, need), max(r, need_r))
         # Mosaic lane/sublane alignment only serves the manual-DMA Pallas
@@ -348,8 +334,10 @@ class StencilContext:
         self._halo_frac = {}
         self._halo_xround = {}       # key -> secs per bare exchange round
         self._halo_xpack = {}        # key -> secs pack-only (no collective)
+        self._halo_cal_spread = {}   # key -> rel spread of the twin trials
         self._halo_xround_last = 0.0
         self._halo_xpack_last = 0.0
+        self._halo_cal_spread_last = 0.0
         for h in self._hooks["after_prepare"]:
             h(self)
 
@@ -604,6 +592,46 @@ class StencilContext:
         from yask_tpu.ops.pallas_stencil import default_vmem_budget
         return default_vmem_budget(self._env.get_platform())
 
+    def _pallas_pad_needs(self, k: int) -> Dict[str, Tuple[int, int]]:
+        """Per-lead-dim ``(left, right)`` pallas pad requirement for fuse
+        depth ``k`` — the ONE definition prepare-time planning and
+        :meth:`_replan_pallas_pads` both use (a replan that plans leaner
+        pads than prepare would silently knock engaged skew dims back to
+        uniform shrink after tuning).
+
+        Beyond the radius×k halo, every dim the skewed wavefront MAY
+        engage (the ``-skew_dims`` window) gets extra RIGHT pad: ceil
+        coverage runs (k−1)·r further right than the uniform grid
+        (final-level writes sit shifted left).  The stream dim absorbs
+        this through VarGeom's 2·sub_t sublane slab slack; the outer dim
+        is an untiled axis with no slack of its own, so without the same
+        budget here every 2-D-skew block fails the overshoot check and
+        falls back to 1-D."""
+        step_rad = self._ana.fused_step_radius()
+        lead = self._ana.domain_dims[:-1]
+        sk_dims = ()
+        if self._opts.skew_wavefront and self._opts.skew_dims_max > 0:
+            sk_dims = lead[-self._opts.skew_dims_max:]
+        needs = {}
+        for d in lead:
+            rd = step_rad.get(d, 0)
+            need = rd * max(k, 1)
+            need_r = need
+            if d in sk_dims:
+                from yask_tpu.compiler.lowering import tpu_tile_dims
+                need_r = need + 2 * tpu_tile_dims(self._csol.dtype)[0]
+                if d == lead[-1]:
+                    # Misaligned (non-sublane-multiple) stream radii:
+                    # the skewed tiling computes E_sk extra right width
+                    # and its widened slabs need the same again in
+                    # rounding room (single E_sk definition:
+                    # pallas_stencil.skew_extra_width).
+                    from yask_tpu.ops.pallas_stencil import \
+                        skew_extra_width
+                    need_r += 2 * skew_extra_width(self._csol.dtype, rd)
+            needs[d] = (need, need_r)
+        return needs
+
     def _replan_pallas_pads(self, k: int) -> None:
         """Shrink pallas pads back to radius×k after the tuner settles.
 
@@ -620,11 +648,9 @@ class StencilContext:
         extra = {d: (self._opts.min_pad_sizes[d],
                      self._opts.min_pad_sizes[d])
                  for d in self._ana.domain_dims}
-        step_rad = self._ana.fused_step_radius()
-        for d in self._ana.domain_dims[:-1]:
-            need = step_rad.get(d, 0) * max(k, 1)
+        for d, (need, need_r) in self._pallas_pad_needs(k).items():
             l, r = extra[d]
-            extra[d] = (max(l, need), max(r, need))
+            extra[d] = (max(l, need), max(r, need_r))
         if extra == self._plan_kwargs.get("extra_pad"):
             return
         import jax.numpy as jnp
@@ -653,17 +679,30 @@ class StencilContext:
         self._jit_cache.clear()
         self._pallas_tiling.clear()
 
+    def _pallas_variant_key(self) -> Tuple:
+        """(skew, skew_dims_max, vmem_mb) cache-key suffix shared by
+        EVERY pallas build variant (single-device and shard): these are
+        the settings beyond (K, block) that change the compiled kernel,
+        so both the jit cache and the tiling record must key on them —
+        the vmem ladder in particular walks the same (K, block) at
+        several budgets and the rungs must never alias each other's
+        executables."""
+        o = self._opts
+        skw = None if o.skew_wavefront else False
+        sdm = o.skew_dims_max if o.skew_wavefront else 0
+        return (skw, sdm, o.vmem_budget_mb)
+
     def _pallas_build_key(self, K: int):
-        """(cache key, block tuple) for the configured pallas build —
-        single definition so stats can look up the tiling the built
-        kernel actually chose (ADVICE r3)."""
+        """(cache key, block tuple, skew arg) for the configured pallas
+        build — single definition so stats can look up the tiling the
+        built kernel actually chose (ADVICE r3)."""
         bs = self._opts.block_sizes
         blk = None
         if any(bs[d] > 0 for d in self._ana.domain_dims[:-1]):
             blk = tuple(bs[d] if bs[d] > 0 else 8
                         for d in self._ana.domain_dims[:-1])
-        skw = None if self._opts.skew_wavefront else False
-        return ("pallas", K, blk, skw), blk, skw
+        var = self._pallas_variant_key()
+        return ("pallas", K, blk) + var, blk, var[0]
 
     def _get_pallas_chunk(self, K: int):
         """Compiled fused-Pallas chunk for K steps with the current block
@@ -676,7 +715,8 @@ class StencilContext:
             chunk, tile_bytes = build_pallas_chunk(
                 self._program, fuse_steps=K, block=blk, interpret=interp,
                 vmem_budget=self.vmem_budget(), skew=skw,
-                vinstr_cap=self._opts.max_tile_vinstr)
+                vinstr_cap=self._opts.max_tile_vinstr,
+                max_skew_dims=self._opts.skew_dims_max)
             self._state_to_device()
             t0c = time.perf_counter()
             if interp:
@@ -931,14 +971,21 @@ class StencilContext:
             if built is not None:
                 return self._program.hbm_bytes_per_point(
                     fuse_steps=built["fuse_steps"],
-                    block=built["block"], skew=built["skew"])
-            from yask_tpu.ops.pallas_stencil import skew_auto_engages
-            skw = (self._opts.skew_wavefront
-                   and skew_auto_engages(self._program, K))
-            if skw and self._opts.mode == "shard_pallas":
-                # distributed skew needs the stream dim unsharded
+                    block=built["block"],
+                    skew=built.get("skew_dims", built["skew"]))
+            from yask_tpu.ops.pallas_stencil import skew_engaged_dims
+            skw = []
+            if self._opts.skew_wavefront:
+                # distributed skew engages per dim only where that dim
+                # is unsharded (the carry cannot cross shards)
                 lead = self._ana.domain_dims[:-1]
-                skw = bool(lead) and self._opts.num_ranks[lead[-1]] <= 1
+                unsh = None
+                if self._opts.mode == "shard_pallas":
+                    unsh = [d for d in lead
+                            if self._opts.num_ranks[d] <= 1]
+                skw = skew_engaged_dims(
+                    self._program, K, unsharded=unsh,
+                    max_dims=self._opts.skew_dims_max)
             return self._program.hbm_bytes_per_point(
                 fuse_steps=K, block=blk, skew=skw)
         return self._program.hbm_bytes_per_point()
@@ -954,10 +1001,10 @@ class StencilContext:
                 "pallas", "shard_pallas"):
             return None
         K = max(1, self._opts.wf_steps)
-        # single blk/skw derivation: _pallas_build_key (the shard run
-        # path uses the identical formula)
-        _key, blk_, skw_ = self._pallas_build_key(K)
-        probe = (self._opts.mode, K, blk_, skw_)
+        # single blk/variant derivation: _pallas_build_key (the shard
+        # run path uses the identical formula)
+        _key, blk_, _skw = self._pallas_build_key(K)
+        probe = (self._opts.mode,) + _key[1:]
         t = self._pallas_tiling.get(probe)
         if t is None:
             # run paths clamp K to the run span (K = min(wf_steps, n)):
@@ -983,6 +1030,7 @@ class StencilContext:
             compile_secs=self._compile_secs,
             halo_exchange_secs=self._halo_xround_last,
             halo_pack_secs=self._halo_xpack_last,
+            halo_cal_spread=self._halo_cal_spread_last,
             read_bytes_pp=rb_pp, write_bytes_pp=wb_pp,
             # aggregate peak: throughput is global (all chips), so the
             # roofline denominator must scale with the mesh size

@@ -72,6 +72,19 @@ class KernelSettings:
         # True = auto (on when the geometry is eligible), False = force
         # the uniform trapezoid shrink.
         self.skew_wavefront = True
+        # How many grid dims the skewed wavefront may engage (per-dim
+        # profit gates still apply): 1 = the innermost stream dim only
+        # (the pre-multi-dim behavior, the 1-D A/B arm), 2 = also the
+        # second-innermost dim (its carry buffers a whole inner grid
+        # row; the multi-dim trapezoid analog of the reference's
+        # wave-front tiling in multiple dims).
+        self.skew_dims_max = 2
+        # Let the joint auto-tuner sweep the Pallas VMEM budget
+        # (64/96/120 MiB ladder) as an outer tuning axis when
+        # vmem_budget_mb is 0 (auto).  Larger budgets admit wider
+        # blocks; Mosaic VMEM OOMs are caught as infeasible candidates
+        # (never fatal), so the ladder is safe to walk on hardware.
+        self.tune_vmem_ladder = True
         # Pallas VMEM budget in MiB (0 = auto: ~16 MiB/core on real TPU
         # per the hardware guide, a loose 100 MiB under CPU interpret
         # where VMEM is emulated). The reference exposes every size knob
@@ -145,8 +158,16 @@ class KernelSettings:
             "path (auto-on when eligible; the trapezoid-blocking "
             "analog).", self, "skew_wavefront")
         parser.add_int_option(
+            "skew_dims", "Max grid dims the skewed wavefront may "
+            "engage (1 = stream dim only, 2 = also the second-inner "
+            "dim).", self, "skew_dims_max")
+        parser.add_int_option(
             "vmem_mb", "Pallas VMEM budget in MiB (0 = derive from the "
             "device).", self, "vmem_budget_mb")
+        parser.add_bool_option(
+            "tune_vmem_ladder", "Let the auto-tuner sweep the VMEM "
+            "budget (64/96/120 MiB) as an outer axis when -vmem_mb is "
+            "0.", self, "tune_vmem_ladder")
         parser.add_int_option(
             "max_vinstr", "Cap on estimated Mosaic vector instructions "
             "per fused kernel (tile-planner growth guard; 0 = off).",

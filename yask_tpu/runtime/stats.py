@@ -16,6 +16,7 @@ class yk_stats:
                  halo_secs: float = 0.0, compile_secs: float = 0.0,
                  halo_exchange_secs: float = 0.0,
                  halo_pack_secs: float = 0.0,
+                 halo_cal_spread: float = 0.0,
                  read_bytes_pp: float = 0.0, write_bytes_pp: float = 0.0,
                  hbm_peak: float = 0.0, tiling: dict | None = None):
         self._npts = npts
@@ -28,6 +29,7 @@ class yk_stats:
         self._compile = compile_secs
         self._halo_xround = halo_exchange_secs
         self._halo_xpack = halo_pack_secs
+        self._halo_cal_spread = halo_cal_spread
         self._rb_pp = read_bytes_pp
         self._wb_pp = write_bytes_pp
         self._hbm_peak = hbm_peak
@@ -100,6 +102,15 @@ class yk_stats:
         reference MPI wait-timer analog."""
         return max(0.0, self._halo_xround - self._halo_xpack)
 
+    def get_halo_cal_spread(self) -> float:
+        """Relative spread ((max−min)/median) across the ≥3 calibration
+        trials behind the halo fraction (real program vs no-exchange
+        twin).  A fraction whose spread is of the same magnitude is
+        noise, not signal — consumers (ledger rows, the sentinel)
+        record this next to the fraction so short-run twin jitter
+        can't masquerade as a halo-cost change."""
+        return self._halo_cal_spread
+
     def get_hbm_bytes_per_point(self) -> float:
         """Modeled HBM traffic (read+write) per point per step."""
         return self._rb_pp + self._wb_pp
@@ -126,6 +137,7 @@ class yk_stats:
                 f"{100.0 * self._halo / self._elapsed if self._elapsed else 0.0:.4g}\n"
                 f"halo-exchange-round (sec): {self._halo_xround:.6g}\n"
                 f"halo-pack (sec): {self._halo_xpack:.6g}\n"
+                f"halo-cal-spread (rel): {self._halo_cal_spread:.4g}\n"
                 f"halo-collective (sec): "
                 f"{self.get_halo_collective_secs():.6g}\n"
                 f"hbm-bytes-per-point (read+write): "

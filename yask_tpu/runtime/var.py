@@ -572,7 +572,31 @@ class yk_var:
         self._dirty = True
         return int(np.prod(data.shape)) if data.shape else 1
 
+    def _resident_ring(self):
+        """The device-resident stripped-interior ring for whole-var
+        fills, or None (strict materializing path).  Fill APIs write by
+        INTERIOR coordinates only, and the resident arrays ARE the
+        interiors (every shard run re-pads + exchanges from them), so
+        an in-place device fill is always consistent — the whole-var
+        twin of :meth:`_resident_idx`, saving the materialize/re-pad
+        round trip the examples' init-between-intervals pattern pays
+        per var."""
+        ctx = self._ctx
+        if ctx._resident is None or self._name not in ctx._resident:
+            return None
+        return ctx._resident[self._name]
+
     def set_all_elements_same(self, val: float) -> None:
+        ring = self._resident_ring()
+        if ring is not None:
+            import jax
+            new = []
+            for a in ring:
+                fill = np.full(a.shape, val, dtype=a.dtype)
+                new.append(jax.device_put(fill, a.sharding))
+            self._ctx._resident[self._name] = new
+            self._dirty = True
+            return
         for slot in range(len(self._ring())):
             self._ctx._update_state_array(
                 self._name, slot, lambda a: np.full_like(np.asarray(a), val))
@@ -585,6 +609,24 @@ class yk_var:
         pad geometry — so differently-padded contexts (jit vs pallas vs
         sharded) start from identical state."""
         g = self._geom()
+        ring = self._resident_ring()
+        if ring is not None:
+            # resident arrays are exactly the interiors (domain dims at
+            # global size, misc axes whole), so the padded path's
+            # interior fill IS a whole-array fill here — same values,
+            # element for element
+            import jax
+            new = []
+            for s, a in enumerate(ring):
+                n = int(np.prod(a.shape)) if a.shape else 1
+                vals = (np.arange(n, dtype=np.float64) % 17 + 1.0) \
+                    * seed * (s + 1)
+                fill = (vals.reshape(a.shape).astype(a.dtype)
+                        if a.shape else vals.astype(a.dtype)[0])
+                new.append(jax.device_put(fill, a.sharding))
+            self._ctx._resident[self._name] = new
+            self._dirty = True
+            return
         for slot in range(len(self._ring())):
             def fill(a, s=slot):
                 a = np.asarray(a)
