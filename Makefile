@@ -7,7 +7,7 @@ TEST_ENV ?= PALLAS_AXON_POOL_IPS=
 
 .PHONY: all native capi test test-fast scratch-tests boundary-tests \
         stages-tests mode-tests bench perfcheck examples clean \
-        list-stencils
+        list-stencils lint check
 
 all: native test
 
@@ -40,9 +40,28 @@ mode-tests:
 bench:
 	$(PY) bench.py
 
+# repo-specific AST rules always run; ruff runs when installed (the
+# container does not ship it — the config in pyproject.toml is for
+# hosts that do)
+lint:
+	$(PY) tools/repo_lint.py
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check .; \
+	else \
+		echo "ruff not installed; skipped (repo_lint ran)"; \
+	fi
+
+# static checker over the flagship configs: Mosaic legality, VMEM
+# feasibility (incl. the round-3 spill-OOM class), races, explain.
+# See docs/checking.md; nonzero exit on any error-severity finding.
+check:
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker \
+		-stencil iso3dfd -radius 8 -g 256 -mode pallas -wf_steps 2
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker -all_stencils
+
 # quick bench rows through the regression sentinel: nonzero exit on an
 # unexplained breach (see tools/perfcheck.py; ledger = PERF_LEDGER.jsonl)
-perfcheck:
+perfcheck: lint
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) tools/perfcheck.py
 
 examples:

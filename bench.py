@@ -144,6 +144,13 @@ def build(fac, env, g, mode="jit", wf=0, radius=8):
     ctx.apply_command_line_options(f"-g {g}")
     ctx.get_settings().mode = mode
     ctx.get_settings().wf_steps = wf
+    # static preflight (default-on, -no-preflight to skip): surfaces
+    # Mosaic/VMEM/race findings up front but never blocks the bench —
+    # the contract line must survive even a checker bug
+    from yask_tpu.checker import preflight
+    if not preflight(ctx):
+        print(f"bench: preflight found errors for mode={mode} "
+              f"(see above); attempting the run anyway", file=sys.stderr)
     ctx.prepare_solution()
     ctx.get_var("pressure").set_element(1.0, [0, g // 2, g // 2, g // 2])
     ctx.get_var("vel").set_all_elements_same(0.1)

@@ -1,0 +1,101 @@
+"""Tests for tools/repo_lint.py: each rule fires on a seeded fixture,
+the pragma escape works, and the repo itself lints clean."""
+
+import os
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import repo_lint  # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_src(tmp_path, src, name="m.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return repo_lint.lint_file(str(p), str(tmp_path))
+
+
+def fired(findings):
+    return [f["rule"] for f in findings]
+
+
+def test_expr_eq_fires(tmp_path):
+    fs = lint_src(tmp_path, """\
+        def f(expr, other):
+            if expr == other:
+                return True
+    """)
+    assert fired(fs) == ["EXPR-EQ"]
+
+
+def test_expr_ne_and_attr_operand(tmp_path):
+    fs = lint_src(tmp_path, """\
+        def f(eq, node):
+            return eq.lhs != node
+    """)
+    assert fired(fs) == ["EXPR-NE"]
+
+
+def test_expr_key_subscript_and_dict_literal(tmp_path):
+    fs = lint_src(tmp_path, """\
+        def f(memo, expr, rhs):
+            memo[expr] = 1
+            return {rhs: 2}
+    """)
+    assert fired(fs) == ["EXPR-KEY", "EXPR-KEY"]
+
+
+def test_bare_devices_fires_and_probe_funcs_sanctioned(tmp_path):
+    fs = lint_src(tmp_path, """\
+        import jax
+
+        def anywhere():
+            return jax.devices()
+
+        def _probe_platform():
+            return jax.devices()
+
+        def _ready():
+            return jax.default_backend() == "cpu"
+    """)
+    assert fired(fs) == ["BARE-DEVICES"]
+    assert fs[0]["line"] == 4
+
+
+def test_pragma_escapes(tmp_path):
+    fs = lint_src(tmp_path, """\
+        import jax
+
+        def f(expr, other, memo):
+            a = expr == other  # lint: expr-eq-ok
+            memo[expr] = 1  # lint: expr-key-ok
+            return jax.devices()  # lint: devices-ok
+    """)
+    assert fs == []
+
+
+def test_clean_code_not_flagged(tmp_path):
+    fs = lint_src(tmp_path, """\
+        def f(expr, other, count):
+            if expr.same(other) and count == 3:
+                return expr.skey()
+            table = {expr.skey(): 1}
+            return table
+    """)
+    assert fs == []
+
+
+def test_ordinary_eq_in_expr_suffix_name_only(tmp_path):
+    # names NOT in the suspect set stay un-flagged
+    fs = lint_src(tmp_path, """\
+        def f(value, mode, cond):
+            return value == 1 and mode != "jit" and cond == True
+    """)
+    assert fs == []
+
+
+def test_repo_is_clean():
+    findings = repo_lint.run_lint([ROOT], root=ROOT)
+    assert findings == [], findings

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Repo-specific AST lint — the rules generic linters cannot know.
+
+Rules (see docs/checking.md for the catalog):
+
+* ``EXPR-EQ`` / ``EXPR-NE`` — Python ``==`` / ``!=`` on expression-AST
+  values.  ``Expr.__eq__`` BUILDS an ``EqualsExpr`` comparison node
+  (that is the DSL), so boolean comparison of two Expr objects is
+  always a bug outside ``compiler/expr.py`` itself — use ``.same()``
+  for structural identity or compare ``.skey()`` strings.
+* ``EXPR-KEY`` — expression nodes used as dict keys / subscripts.
+  With ``__eq__`` overloaded, dict lookup degenerates to identity-ish
+  hash behavior; key memo tables by ``.skey()`` instead.
+* ``BARE-DEVICES`` — ``jax.devices()`` / ``jax.default_backend()``
+  outside the sanctioned probe helpers.  A bare backend query dials
+  the axon TPU relay and can hang a driver artifact for minutes; only
+  the killable-subprocess probes (``_probe_platform``, ``_ready``) and
+  explicitly pragma'd TPU-session tools may touch it.
+
+Detection of "an Expr value" is lexical (this is a linter, not a type
+checker): names ``expr``/``lhs``/``rhs``/``eq``, the ``*_expr``
+suffix, and attribute access ``.lhs`` / ``.rhs``.  Escape hatch: put
+``# lint: <rule>-ok`` on the flagged line (rule tokens: ``expr-eq``,
+``expr-key``, ``devices``).
+
+Usage: ``python tools/repo_lint.py [paths...]`` — defaults to the
+repo root; exit 1 when anything fires.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+from typing import List, Optional
+
+SKIP_DIRS = {".git", ".perf_bisect", "__pycache__", ".claude",
+             ".pytest_cache", "build"}
+# expr.py defines the overloaded operators — == is the DSL there
+EXPR_RULE_EXEMPT = {os.path.join("yask_tpu", "compiler", "expr.py")}
+
+_SUSPECT_NAMES = {"expr", "lhs", "rhs", "eq"}
+_SUSPECT_ATTRS = {"lhs", "rhs"}
+_PROBE_FUNCS = {"_probe_platform", "_ready"}
+
+
+def _is_expr_operand(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        n = node.id
+        return n in _SUSPECT_NAMES or n.endswith("_expr")
+    if isinstance(node, ast.Attribute):
+        return node.attr in _SUSPECT_ATTRS or node.attr.endswith("_expr")
+    return False
+
+
+def _is_backend_call(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute)
+            and f.attr in ("devices", "default_backend")
+            and isinstance(f.value, ast.Name) and f.value.id == "jax")
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, relpath: str, lines: List[str]):
+        self.relpath = relpath
+        self.lines = lines
+        self.findings: List[dict] = []
+        self._func_stack: List[str] = []
+
+    def _pragma(self, lineno: int, token: str) -> bool:
+        line = self.lines[lineno - 1] if lineno - 1 < len(self.lines) else ""
+        return f"# lint: {token}-ok" in line
+
+    def _add(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.findings.append({"rule": rule, "path": self.relpath,
+                              "line": node.lineno, "message": msg})
+
+    # ---- scope tracking -------------------------------------------------
+    def visit_FunctionDef(self, node):
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # ---- rules ----------------------------------------------------------
+    def visit_Compare(self, node: ast.Compare):
+        if self.relpath not in EXPR_RULE_EXEMPT:
+            operands = [node.left] + list(node.comparators)
+            for op in node.ops:
+                if isinstance(op, (ast.Eq, ast.NotEq)):
+                    hit = next((o for o in operands
+                                if _is_expr_operand(o)), None)
+                    if hit is not None and not self._pragma(
+                            node.lineno, "expr-eq"):
+                        rule = ("EXPR-EQ" if isinstance(op, ast.Eq)
+                                else "EXPR-NE")
+                        self._add(
+                            rule, node,
+                            f"Python {'==' if rule == 'EXPR-EQ' else '!='} "
+                            "on an expression node builds an AST "
+                            "comparison, not a bool — use .same() / "
+                            ".skey()")
+                        break
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if (self.relpath not in EXPR_RULE_EXEMPT
+                and _is_expr_operand(node.slice)
+                and not self._pragma(node.lineno, "expr-key")):
+            self._add("EXPR-KEY", node,
+                      "expression node used as a dict/table key — "
+                      "__eq__ is overloaded; key by .skey()")
+        self.generic_visit(node)
+
+    def visit_Dict(self, node: ast.Dict):
+        if self.relpath not in EXPR_RULE_EXEMPT:
+            for k in node.keys:
+                if k is not None and _is_expr_operand(k) \
+                        and not self._pragma(k.lineno, "expr-key"):
+                    self._add("EXPR-KEY", k,
+                              "expression node used as a dict key — "
+                              "__eq__ is overloaded; key by .skey()")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        if _is_backend_call(node):
+            sanctioned = any(f in _PROBE_FUNCS for f in self._func_stack)
+            if not sanctioned and not self._pragma(node.lineno, "devices"):
+                self._add(
+                    "BARE-DEVICES", node,
+                    "bare jax backend query outside a sanctioned probe "
+                    "helper — this dials the TPU relay and can hang; "
+                    "route through _probe_platform/env, or pragma a "
+                    "deliberate TPU-session tool")
+        self.generic_visit(node)
+
+
+def lint_file(path: str, root: str) -> List[dict]:
+    relpath = os.path.relpath(path, root)
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [{"rule": "PARSE-ERROR", "path": relpath,
+                 "line": e.lineno or 0, "message": str(e.msg)}]
+    linter = _Linter(relpath, src.splitlines())
+    linter.visit(tree)
+    return linter.findings
+
+
+def iter_py_files(paths: List[str], root: str):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def run_lint(paths: Optional[List[str]] = None,
+             root: Optional[str] = None) -> List[dict]:
+    root = root or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = paths or [root]
+    findings: List[dict] = []
+    for path in iter_py_files(paths, root):
+        findings.extend(lint_file(path, root))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    findings = run_lint(argv or None)
+    if as_json:
+        print(json.dumps(findings, indent=2))
+    else:
+        for f in findings:
+            print(f"{f['path']}:{f['line']}: {f['rule']}: {f['message']}")
+        print(f"repo_lint: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

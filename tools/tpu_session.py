@@ -67,6 +67,13 @@ def build(fac, env, name, mode, g, radius, wf=1, block=None, tune=False,
     if block:
         for d, b in block.items():
             ctx.set_block_size(d, b)
+    # static preflight (default-on): catch statically-infeasible configs
+    # (the round-3 VMEM-spill class) BEFORE spending relay-window time
+    # on a compile; findings are logged, the stage still proceeds so a
+    # checker false-positive cannot cost a hardware window
+    from yask_tpu.checker import preflight
+    if not preflight(ctx):
+        log("preflight", name=name, mode=mode, ok=False)
     ctx.prepare_solution()
     init_solution_vars(ctx)
     return ctx

@@ -1,0 +1,148 @@
+"""CLI for the static checker.
+
+Usage::
+
+    python -m yask_tpu.checker -stencil iso3dfd -radius 8 -g 512 \
+        -mode pallas -wf_steps 2 [-vmem_mb 120] [-json] [-verbose]
+    python -m yask_tpu.checker -all_stencils          # zero-false-error
+    python -m yask_tpu.checker -list
+
+All kernel options (``-g``, ``-b``, ``-mode``, ``-wf_steps``,
+``-vmem_mb``, ``-nr``, …) pass through to the solution settings, same
+as the harness.  Exit codes: 0 = no errors, 1 = errors found, 2 =
+usage error.  Nothing executes and nothing allocates — checking a 512³
+configuration costs geometry arithmetic, not gigabytes.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from yask_tpu.utils.cli import CommandLineParser
+from yask_tpu.utils.exceptions import YaskException
+
+
+class CheckerSettings:
+    def __init__(self):
+        self.stencil = ""
+        self.radius = 0
+        self.json = False
+        self.verbose = False
+        self.all_stencils = False
+        self.list_stencils = False
+        self.help = False
+
+    def add_options(self, p: CommandLineParser) -> None:
+        p.add_string_option("stencil", "Registered stencil name.",
+                            self, "stencil")
+        p.add_int_option("radius", "Stencil radius (0 = default).",
+                         self, "radius")
+        p.add_bool_option("json", "Emit the machine-readable report "
+                          "(schema yask_tpu.checker/1).", self, "json")
+        p.add_bool_option("verbose", "Show info-level diagnostics "
+                          "(the explain pass) in text output.",
+                          self, "verbose")
+        p.add_bool_option("all_stencils", "Sweep every registered "
+                          "stencil (jit + pallas where applicable) with "
+                          "the given kernel options; nonzero exit on "
+                          "any error.", self, "all_stencils")
+        p.add_bool_option("list", "List registered stencils.",
+                          self, "list_stencils")
+        p.add_bool_option("help", "Print help.", self, "help")
+
+
+def _build(stencil: str, radius: int, extra_args: List[str]):
+    from yask_tpu import yk_factory
+    fac = yk_factory()
+    env = fac.new_env()
+    ctx = fac.new_solution(env, stencil=stencil, radius=radius or None)
+    rest = ctx.apply_command_line_options(extra_args)
+    if rest:
+        raise YaskException(f"unrecognized options: {' '.join(rest)}")
+    return ctx
+
+
+def run_checker(argv: Optional[List[str]] = None, out=None) -> int:
+    out = out or sys.stdout
+    opts = CheckerSettings()
+    p = CommandLineParser()
+    opts.add_options(p)
+    rest = p.parse_args(list(argv if argv is not None else sys.argv[1:]))
+
+    if opts.help:
+        out.write("yask_tpu.checker options:\n")
+        p.print_help(out)
+        out.write("\nplus all kernel options (-g, -d, -b, -nr, -mode, "
+                  "-wf_steps, -vmem_mb, ...):\n")
+        return 0
+    from yask_tpu.compiler.solution_base import get_registered_solutions
+    if opts.list_stencils:
+        out.write("\n".join(get_registered_solutions()) + "\n")
+        return 0
+
+    from yask_tpu.checker import run_checks
+
+    if opts.all_stencils:
+        # Known-good sweep: every registered stencil in jit mode plus
+        # pallas where applicable; any error fails the run.  The per-
+        # stencil default radius and sizes keep each config realistic.
+        from yask_tpu.ops.pallas_stencil import pallas_applicable
+        if not any(a.startswith(("-g", "-d")) for a in rest):
+            rest = ["-g", "32"] + list(rest)
+        failures = 0
+        for name in get_registered_solutions():
+            for mode in ("jit", "pallas"):
+                try:
+                    ctx = _build(name, opts.radius, list(rest))
+                except YaskException as e:
+                    out.write(f"{name}: BUILD FAILED: {e}\n")
+                    failures += 1
+                    break
+                if mode == "pallas":
+                    ok, _why = pallas_applicable(ctx._csol)
+                    if not ok:
+                        continue  # fallback is expected, not an error
+                    ctx.get_settings().wf_steps = max(
+                        ctx.get_settings().wf_steps, 2)
+                ctx.get_settings().mode = mode
+                report = run_checks(ctx)
+                n_err = len(report.errors)
+                status = "FAIL" if n_err else "ok"
+                out.write(f"{name:24s} {mode:7s} {status}"
+                          + (f" ({n_err} error(s))" if n_err else "")
+                          + "\n")
+                if n_err:
+                    for d in report.errors:
+                        out.write("    " + d.format() + "\n")
+                    failures += 1
+        out.write(f"all_stencils sweep: "
+                  f"{'FAIL' if failures else 'clean'}\n")
+        return 1 if failures else 0
+
+    if not opts.stencil:
+        out.write("error: -stencil <name> required; -list to "
+                  "enumerate, -all_stencils to sweep.\n")
+        return 2
+
+    ctx = _build(opts.stencil, opts.radius, list(rest))
+    report = run_checks(ctx)
+    if opts.json:
+        out.write(report.json_str() + "\n")
+    else:
+        out.write(report.render(verbose=opts.verbose))
+    return 0 if report.ok() else 1
+
+
+def main() -> None:  # pragma: no cover - thin wrapper
+    try:
+        sys.exit(run_checker())
+    except YaskException as e:
+        sys.stderr.write(f"error: {e}\n")
+        sys.exit(2)
+    except BrokenPipeError:   # |head closed the pipe — not an error
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

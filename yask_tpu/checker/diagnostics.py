@@ -1,0 +1,136 @@
+"""Diagnostic model for the static checker.
+
+Every rule violation (or informational note) becomes a
+:class:`Diagnostic`: a stable rule id, a severity, the subject
+(var/dim/stage when applicable), a human message, and an optional
+machine-readable ``detail`` dict.  A :class:`CheckReport` collects the
+diagnostics of one checker run plus the configuration they were produced
+against, and serializes to the JSON schema documented in
+``docs/checking.md`` (``yask_tpu.checker/1``).
+
+The severity policy (also in ``docs/checking.md``):
+
+* ``error``  — the configuration will fail or corrupt results if run
+  (Mosaic would reject the kernel, VMEM cannot fit, a race breaks
+  cross-mode equivalence).  Preflight prints these and returns False.
+* ``warn``   — the configuration runs but not the way the user asked
+  (auto-fallbacks, near-limit budgets).
+* ``info``   — explanation of decisions taken (profit gates, pipelining,
+  SMEM routing); the explain pass emits mostly these.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+SCHEMA = "yask_tpu.checker/1"
+SEVERITIES = ("error", "warn", "info")
+
+
+@dataclass
+class Diagnostic:
+    rule: str                      # stable id, e.g. "MOSAIC-LANE-ALIGN"
+    severity: str                  # error | warn | info
+    message: str                   # human-readable, one line
+    var: Optional[str] = None      # subject var, when applicable
+    dim: Optional[str] = None      # subject dim, when applicable
+    stage: Optional[int] = None    # subject stage index, when applicable
+    detail: Optional[dict] = None  # machine-readable extras
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"severity must be one of {SEVERITIES}, "
+                             f"got {self.severity!r}")
+
+    def to_dict(self) -> dict:
+        out = {"rule": self.rule, "severity": self.severity,
+               "message": self.message}
+        for k in ("var", "dim", "stage", "detail"):
+            v = getattr(self, k)
+            if v is not None:
+                out[k] = v
+        return out
+
+    def format(self) -> str:
+        subj = "".join(
+            f" [{k}={v}]" for k, v in (("var", self.var), ("dim", self.dim),
+                                       ("stage", self.stage))
+            if v is not None)
+        return f"{self.severity:5s} {self.rule}{subj}: {self.message}"
+
+
+@dataclass
+class CheckReport:
+    """All diagnostics of one checker run over one configuration."""
+
+    config: Dict[str, object] = field(default_factory=dict)
+    passes: List[str] = field(default_factory=list)
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+
+    def add(self, rule: str, severity: str, message: str, **kw) -> Diagnostic:
+        d = Diagnostic(rule=rule, severity=severity, message=message, **kw)
+        self.diagnostics.append(d)
+        return d
+
+    def ran(self, pass_name: str) -> None:
+        if pass_name not in self.passes:
+            self.passes.append(pass_name)
+
+    def by_severity(self, severity: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == severity]
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return self.by_severity("error")
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return self.by_severity("warn")
+
+    def rules_fired(self) -> List[str]:
+        seen, out = set(), []
+        for d in self.diagnostics:
+            if d.rule not in seen:
+                seen.add(d.rule)
+                out.append(d.rule)
+        return out
+
+    def ok(self) -> bool:
+        return not self.errors
+
+    def to_json(self) -> dict:
+        return {
+            "schema": SCHEMA,
+            "config": dict(self.config),
+            "passes": list(self.passes),
+            "diagnostics": [d.to_dict() for d in self.diagnostics],
+            "summary": {s: len(self.by_severity(s)) for s in SEVERITIES},
+        }
+
+    def json_str(self) -> str:
+        return json.dumps(self.to_json(), indent=2, default=str)
+
+    def render(self, verbose: bool = False) -> str:
+        """Human text: errors and warnings always, infos with
+        ``verbose`` (the explain pass is info-heavy)."""
+        lines = []
+        cfg = self.config
+        head = " ".join(f"{k}={v}" for k, v in cfg.items())
+        lines.append(f"checker: {head}")
+        shown = 0
+        for d in self.diagnostics:
+            if d.severity == "info" and not verbose:
+                continue
+            lines.append("  " + d.format())
+            shown += 1
+        n_err, n_warn = len(self.errors), len(self.warnings)
+        n_info = len(self.by_severity("info"))
+        if not verbose and n_info:
+            lines.append(f"  ({n_info} info note(s) — -verbose or -json "
+                         "to see them)")
+        lines.append(f"checker result: {'FAIL' if n_err else 'ok'} "
+                     f"({n_err} error(s), {n_warn} warning(s), "
+                     f"{n_info} info)")
+        return "\n".join(lines) + "\n"

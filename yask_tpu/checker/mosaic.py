@@ -1,0 +1,156 @@
+"""Mosaic-legality pass: the probed TPU TC rules as executable checks.
+
+Provenance: every rule here encodes a constraint probed on TPU v5e
+during round 3 (see CLAUDE.md "Mosaic TC rules" and docs/checking.md):
+DMA windows on HBM/ANY refs need lane (last-axis) sizes and offsets
+that are 128-multiples and sublane (2nd-last) 8-multiples (f32;
+dtype-scaled via ``tpu_tile_dims``), misc axes must be physically
+first, vars whose last domain dim is not the solution minor cannot be
+windowed, and no-domain-dim vars ride SMEM.  ``VarGeom`` normally
+*constructs* geometry that satisfies all of this when planned with
+``mosaic_align=True``; this pass proves the property of a concrete
+plan instead of trusting the construction — a planner regression (or a
+plan made with ``mosaic_align=False`` fed to the pallas path) turns
+into diagnostics here rather than an on-hardware Mosaic crash.
+"""
+
+from __future__ import annotations
+
+from yask_tpu.checker.diagnostics import CheckReport
+
+PASS = "mosaic"
+
+#: Expr node types the in-kernel evaluator (``_TileEval``) lowers —
+#: anything outside this set cannot be expressed with the legal Mosaic
+#: pattern vocabulary (lax.pad + broadcasted_iota masks + jnp.where; no
+#: dynamic_update_slice, no scatter) and would die in the generator.
+_SUPPORTED_NODES = (
+    "ConstExpr", "VarPoint", "IndexExpr", "FirstIndexExpr",
+    "LastIndexExpr", "NegExpr", "AddExpr", "MultExpr", "SubExpr",
+    "DivExpr", "ModExpr", "FuncExpr", "CompExpr", "AndExpr", "OrExpr",
+    "NotExpr", "EqualsExpr",
+)
+
+
+def _walk_nodes(e):
+    yield e
+    for attr in ("args", ):
+        for a in getattr(e, attr, ()) or ():
+            yield from _walk_nodes(a)
+    for attr in ("lhs", "rhs", "arg", "cond", "step_cond"):
+        a = getattr(e, attr, None)
+        if a is not None and hasattr(a, "skey"):
+            yield from _walk_nodes(a)
+
+
+def check_mosaic(report: CheckReport, ctx, program) -> None:
+    """Run the Mosaic-legality rules over a planned program."""
+    report.ran(PASS)
+    mode = ctx._mode
+    if mode not in ("pallas", "shard_pallas"):
+        report.add("MOSAIC-SKIPPED", "info",
+                   f"mode '{mode}' uses no manual Mosaic DMA; lane/"
+                   "sublane legality does not apply")
+        return
+
+    from yask_tpu.ops.pallas_stencil import pallas_applicable
+    ok, why = pallas_applicable(ctx._csol)
+    if not ok:
+        report.add("PALLAS-APPLICABLE", "error",
+                   f"solution cannot use the {mode} path: {why}",
+                   detail={"reason": why})
+
+    if not getattr(program, "mosaic_align", True):
+        report.add("MOSAIC-ALIGN-OFF", "error",
+                   "program was planned with mosaic_align=False but the "
+                   f"'{mode}' mode issues manual DMAs on tiled HBM "
+                   "memrefs; windows would be unaligned (probed v5e "
+                   "rule)")
+
+    from yask_tpu.compiler.lowering import tpu_tile_dims
+    sub_t, lane_t = tpu_tile_dims(program.dtype)
+    minor = program.ana.domain_dims[-1] if program.ana.domain_dims else None
+
+    for name in sorted(program.geoms):
+        g = program.geoms[name]
+        # misc axes must be physically FIRST (VarGeom invariant): a misc
+        # axis in the last-two (tiled) positions of a domain-dim var
+        # would put tiny extents on the lane/sublane tiles.
+        seen_domain = False
+        for dn, kind in g.axes:
+            if kind == "domain":
+                seen_domain = True
+            elif seen_domain:
+                report.add("MOSAIC-MISC-FIRST", "error",
+                           f"misc axis '{dn}' follows a domain axis in "
+                           f"the physical order of var '{name}' — misc "
+                           "axes must be physically first (element/"
+                           "slice APIs translate declared→physical)",
+                           var=name, dim=dn)
+        if not g.domain_dims:
+            report.add("MOSAIC-SMEM", "info",
+                       f"var '{name}' has no domain dims: rides SMEM "
+                       "with static scalar reads (no DMA, no VMEM "
+                       "tile)", var=name)
+            continue
+        if g.is_scratch:
+            continue  # scratch tiles never touch HBM: unconstrained
+        # lane (last physical) axis: the DMA fetches it WHOLE, and a
+        # full-extent slice of an array whose lane total is not a
+        # 128-multiple is itself an unaligned window (physical tiled
+        # layout ≠ logical extent — probed v5e).
+        lane_dim, lane_kind = g.axes[-1]
+        if g.shape[-1] % lane_t != 0:
+            report.add("MOSAIC-LANE-ALIGN", "error",
+                       f"var '{name}' lane axis '{lane_dim}' has total "
+                       f"extent {g.shape[-1]}, not a multiple of "
+                       f"{lane_t} — full-extent DMA windows on it are "
+                       "unaligned (tiled physical layout)",
+                       var=name, dim=lane_dim,
+                       detail={"extent": g.shape[-1], "lane_t": lane_t})
+        if lane_kind == "domain" and minor is not None \
+                and lane_dim != minor:
+            report.add("MOSAIC-MINOR-DIM", "error",
+                       f"var '{name}' lane axis is '{lane_dim}' but the "
+                       f"solution minor is '{minor}': lane windows "
+                       "would need pid-dependent non-128 offsets",
+                       var=name, dim=lane_dim)
+        # sublane (2nd-last) axis, when it is a lead domain dim, gets
+        # 8-aligned windows: origin and total must be sub_t multiples
+        # (VarGeom rounds the origin and adds 2·sub_t slab slack).
+        if len(g.axes) >= 2:
+            sdn, skind = g.axes[-2]
+            if skind == "domain" and sdn != minor:
+                if g.origin[sdn] % sub_t != 0:
+                    report.add("MOSAIC-SUBLANE-ALIGN", "error",
+                               f"var '{name}' sublane origin in dim "
+                               f"'{sdn}' is {g.origin[sdn]}, not a "
+                               f"multiple of {sub_t} — DMA window "
+                               "offsets on the sublane axis must be "
+                               "tile-aligned", var=name, dim=sdn,
+                               detail={"origin": g.origin[sdn],
+                                       "sub_t": sub_t})
+                ax = g.axis_of(sdn)
+                if g.shape[ax] % sub_t != 0:
+                    report.add("MOSAIC-SUBLANE-ALIGN", "error",
+                               f"var '{name}' sublane total extent in "
+                               f"dim '{sdn}' is {g.shape[ax]}, not a "
+                               f"multiple of {sub_t}", var=name,
+                               dim=sdn,
+                               detail={"extent": g.shape[ax],
+                                       "sub_t": sub_t})
+
+    # forbidden in-kernel patterns: the tile evaluator only lowers the
+    # node vocabulary below (everything else would need
+    # dynamic_update_slice / scatter, which Mosaic TC rejects — static
+    # region inserts go through lax.pad + broadcasted_iota instead).
+    for eq in ctx._csol.soln.get_equations():
+        for node in _walk_nodes(eq):
+            tname = type(node).__name__
+            if tname not in _SUPPORTED_NODES:
+                report.add("MOSAIC-KERNEL-OPS", "error",
+                           f"equation '{eq.format_simple()}' contains "
+                           f"a {tname} node the in-kernel evaluator "
+                           "cannot lower with Mosaic-legal patterns",
+                           var=eq.lhs.var_name(),
+                           detail={"node": tname})

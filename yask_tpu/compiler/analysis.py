@@ -75,6 +75,25 @@ def _cond_key(cond) -> tuple:
     return cond.skey() if cond is not None else ()
 
 
+def missing_dim_race(eq: EqualsExpr, domain_dims: Sequence[str]) -> Set[str]:
+    """The dims along which ``eq``'s RHS/conditions VARY while its LHS
+    var lacks them — each such dim is an intra-step race: every point of
+    the missing extent would demand a different value for the single
+    stored slab.  Returns the racy dim set (empty = fine).
+
+    THE single definition of the missing-dim race rule:
+    ``_validate_and_scan`` raises on it during analysis, and the static
+    checker (``yask_tpu.checker.races``) reports it as a non-raising
+    diagnostic over un-analyzed solutions."""
+    var = eq.lhs.get_var()
+    lhs_dd = set(var.domain_dim_names())
+    missing = [d for d in domain_dims if d not in lhs_dd]
+    if not missing:
+        return set()
+    from yask_tpu.compiler.expr import used_domain_dims
+    return used_domain_dims(eq.rhs, eq.cond, eq.step_cond) & set(missing)
+
+
 class SolutionAnalysis:
     """Full analysis result for one solution (the pipeline of
     ``Solution::analyze_solution``, ``Solution.cpp:127-160``)."""
@@ -137,25 +156,20 @@ class SolutionAnalysis:
                 var.update_misc_range(d, val)
 
             # A write to a var lacking some solution domain dims must
-            # not read anything that varies along those dims: every
-            # point of the missing extent would demand a different value
-            # for the single stored slab — an intra-step race.  (The
-            # reference cannot even express this: its loop nest is the
-            # LHS var's dims, Eqs.cpp:364-470.)  All lowering backends
-            # then agree on collapsing the constant extent.
-            lhs_dd = set(var.domain_dim_names())
-            missing = [d for d in self.domain_dims if d not in lhs_dd]
-            if missing:
-                from yask_tpu.compiler.expr import used_domain_dims
-                varying = used_domain_dims(
-                    eq.rhs, eq.cond, eq.step_cond) & set(missing)
-                if varying:
-                    raise YaskException(
-                        f"'{eq.format_simple()}' writes var "
-                        f"'{var.get_name()}' (no dim "
-                        f"{sorted(varying)}) but its RHS/condition "
-                        f"varies along {sorted(varying)} — an "
-                        "intra-step race")
+            # not read anything that varies along those dims — an
+            # intra-step race.  (The reference cannot even express
+            # this: its loop nest is the LHS var's dims,
+            # Eqs.cpp:364-470.)  All lowering backends then agree on
+            # collapsing the constant extent.  missing_dim_race is the
+            # single definition, shared with the static checker.
+            varying = missing_dim_race(eq, self.domain_dims)
+            if varying:
+                raise YaskException(
+                    f"'{eq.format_simple()}' writes var "
+                    f"'{var.get_name()}' (no dim "
+                    f"{sorted(varying)}) but its RHS/condition "
+                    f"varies along {sorted(varying)} — an "
+                    "intra-step race")
 
             # Scan RHS (and conditions) reads: halos, misc ranges, steps.
             pv = PointVisitor()

@@ -158,15 +158,15 @@ class Var:
     def get_prefetch_dist(self) -> int:
         return getattr(self, "_prefetch_dist", 0)
 
-    def get_step_alloc_size(self) -> int:
-        """#step slots needed (reference lifespan calc, ``Eqs.cpp:1912``):
-        the span of step offsets used, *minus one* when the extreme read
+    def min_step_alloc_size(self) -> int:
+        """The ring depth this var's step accesses actually NEED,
+        ignoring any manual :meth:`set_step_alloc_size` override: the
+        span of step offsets used, *minus one* when the extreme read
         offset carries no spatial halo — then its slot doubles as the
         write target, point-wise-safely (the reference's write-back
-        optimization; for 2nd-order-in-time stencils like iso3dfd this is
-        2 buffers instead of 3)."""
-        if self._step_alloc is not None:
-            return self._step_alloc
+        optimization; for 2nd-order-in-time stencils like iso3dfd this
+        is 2 buffers instead of 3).  The static checker compares a
+        manual override against this floor (RING-DEPTH rule)."""
         if self.step_dim() is None:
             return 1
         if not self.step_offsets_used:
@@ -180,6 +180,13 @@ class Var:
             if self.step_read_halo.get(extreme, None) == 0:
                 span -= 1
         return max(span, 1)
+
+    def get_step_alloc_size(self) -> int:
+        """#step slots kept (reference lifespan calc, ``Eqs.cpp:1912``):
+        the manual override when set, else :meth:`min_step_alloc_size`."""
+        if self._step_alloc is not None:
+            return self._step_alloc
+        return self.min_step_alloc_size()
 
     def __repr__(self):
         kind = "scratch " if self._is_scratch else ""

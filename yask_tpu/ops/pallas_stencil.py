@@ -459,6 +459,15 @@ def default_vmem_budget(platform: str) -> int:
     return 64 * 2 ** 20 if platform == "tpu" else 100 * 2 ** 20
 
 
+def vmem_limit_bytes(vmem_budget: int) -> int:
+    """Scoped Mosaic VMEM limit requested for a given tile budget:
+    2× the budget (live SSA values ≈ a second copy of the tiles),
+    capped at the 128 MiB that is safely below the ≥120..128 MiB range
+    probed on v5e.  Single definition — the kernel's CompilerParams and
+    the static checker's spill model both use it."""
+    return int(min(128 * 2 ** 20, 2 * vmem_budget))
+
+
 def build_pallas_chunk(program, fuse_steps: int = 1,
                        block: Optional[Tuple[int, ...]] = None,
                        interpret: bool = False,
@@ -469,7 +478,9 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                        vinstr_cap: int = 300_000,
                        stream_unsharded: bool = False,
                        unsharded_dims=None,
-                       max_skew_dims: int = 2):
+                       max_skew_dims: int = 2,
+                       plan_only: bool = False,
+                       reasons: Optional[List[dict]] = None):
     """Build ``chunk(state, t0) -> state`` advancing ``fuse_steps`` steps
     in one fused Pallas sweep.
 
@@ -512,6 +523,13 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     the skew margins whenever the profit gate engages (mR = r+E_sk ≤
     r·K exactly when E_sk < (K−1)·r); mesh-decomposed dims keep the
     uniform shrink.
+
+    Every planning decision (skew engage/reject, ladder fallback, block
+    shrink, DMA-pipelining on/off) appends a structured reason code to
+    ``reasons`` — surfaced through ``chunk.tiling["reasons"]`` and read
+    by the static checker's explain pass.  ``plan_only=True`` stops
+    after planning (no kernel is traced, nothing allocates) and returns
+    the plan dict instead of ``(chunk, tile_bytes)``.
     """
     import jax
     import jax.numpy as jnp
@@ -522,6 +540,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     ana = program.ana
     dims = ana.domain_dims
     K = fuse_steps
+    if reasons is None:
+        reasons = []
     from yask_tpu.compiler.expr import uses_misc_index
     has_misc_value = any(
         uses_misc_index(eq.rhs, eq.cond, eq.step_cond) for eq in ana.eqs)
@@ -625,6 +645,35 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 f"{sorted(g.name for g in program.geoms.values() if g.is_written and not g.is_scratch and g.domain_dims != dims)}")
     use_skew = bool(skew_dims)
     skew_set = set(skew_dims)
+    # Structured reason codes for the skew decision (explain pass): one
+    # per leading dim under auto-engage, one summary line when forced or
+    # disabled.  Codes, not prose, so tools can branch on them.
+    if skew is None:
+        window = set(lead[-max_skew_dims:]) if max_skew_dims > 0 else set()
+        for d in lead:
+            if d in skew_set:
+                reasons.append({
+                    "code": "skew_engaged", "dim": d,
+                    "detail": f"profit gate ({K}+1)*{rad[d]}"
+                              f"+{E_all.get(d, 0)} < 2*{K}*{rad[d]}"})
+            elif d in elig_dims and d in unsharded_dims and d in window:
+                reasons.append({
+                    "code": "skew_gate_rejected", "dim": d,
+                    "detail": f"({K}+1)*{rad[d]}+{E_all.get(d, 0)} >= "
+                              f"2*{K}*{rad[d]}"})
+            else:
+                why = ("outside max_skew_dims window" if d not in window
+                       else "mesh-decomposed (carry cannot cross shards)"
+                       if d not in unsharded_dims else
+                       "ineligible (K<2, radius 0, or partial-dim "
+                       "written vars)")
+                reasons.append({"code": "skew_ineligible", "dim": d,
+                                "detail": why})
+    elif forced:
+        reasons.append({"code": "skew_forced", "dims": list(skew_dims)})
+    else:
+        reasons.append({"code": "skew_disabled",
+                        "detail": "skew=False requested"})
     R = dict(rad)
     # Misaligned (non-sublane-multiple) stream radii: every skewed
     # region carries E_sk extra computed width on its right so the
@@ -743,10 +792,16 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 "pads or different block sizes")
         return b
 
-    def _fallback():
+    def _fallback(cause: str):
         """Auto-engaged skew that turned out infeasible steps DOWN the
         ladder — 2-D → 1-D → uniform — rather than failing a
-        configuration a narrower tiling still fits."""
+        configuration a narrower tiling still fits.  Each step records a
+        structured reason (the ladder is no longer silent)."""
+        reasons.append({
+            "code": "skew_fallback", "cause": cause,
+            "from_dims": list(skew_dims),
+            "to": ("1-D skew" if len(skew_dims) >= 2 else
+                   "uniform shrink")})
         return build_pallas_chunk(
             program, fuse_steps=fuse_steps, block=block_arg,
             interpret=interpret, vmem_budget=vmem_budget,
@@ -754,16 +809,23 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             skew=(None if len(skew_dims) >= 2 else False),
             vinstr_cap=vinstr_cap, stream_unsharded=stream_unsharded,
             unsharded_dims=unsharded_dims,
-            max_skew_dims=max(len(skew_dims) - 1, 0))
+            max_skew_dims=max(len(skew_dims) - 1, 0),
+            plan_only=plan_only, reasons=reasons)
 
     try:
+        _block_req = dict(block)
         for d in lead:
             block[d] = _fit_block(d, block[d])
+        if block != _block_req:
+            reasons.append({
+                "code": "block_fitted", "from": _block_req,
+                "to": dict(block),
+                "detail": "sublane/overshoot alignment fit"})
     except YaskException:
         if use_skew and not forced:
             # auto-engaged skew whose wider slabs don't fit the planned
             # pads (small misaligned radii): narrower tilings still fit
-            return _fallback()
+            return _fallback("DMA slab rounding exceeds planned pads")
         raise
 
     var_order = [n for n in sorted(program.geoms)
@@ -861,6 +923,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         return in_b, work_b
 
     in_tile_bytes, work_bytes = _tile_bytes()
+    _block0 = dict(block)
     # planner-chosen blocks auto-shrink until the tile model fits (its
     # model can undercount misc slots / alignment rounding); explicitly
     # requested blocks fail fast instead — the auto-tuner relies on the
@@ -879,6 +942,10 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         block[d] = nb
         _plan_slabs()
         in_tile_bytes, work_bytes = _tile_bytes()
+    if block != _block0:
+        reasons.append({"code": "block_shrunk", "from": _block0,
+                        "to": dict(block),
+                        "detail": "tile model over VMEM budget"})
     # Skew feasibility: each skewed dim's carry save-strips must come
     # from the tile's own valid region (block[d] ≥ (D+1)·r, D = deepest
     # carried ring), and the carry buffers must fit the budget
@@ -899,7 +966,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                     f"VMEM budget; got "
                     f"block {[(d, block[d]) for d in skew_dims]}, "
                     f"{(in_tile_bytes + work_bytes)/2**20:.1f} MiB")
-            return _fallback()
+            return _fallback("carry floor (ring+1)*r or carry VMEM "
+                             "does not fit")
 
     tile_bytes = in_tile_bytes + work_bytes
     if tile_bytes > vmem_budget:
@@ -918,10 +986,19 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # Costs 2x input-tile VMEM; auto-disabled when that busts the budget
     # or there's only one grid step. Grid dims are declared "arbitrary"
     # (sequential) so the linear-index prefetch is sound.
+    _pipe_req = pipeline_dmas
     if pipeline_dmas is None:
         pipeline_dmas = (total_steps > 1
                          and 2 * in_tile_bytes + work_bytes <= vmem_budget)
     use_pipe = bool(pipeline_dmas) and total_steps > 1
+    reasons.append(
+        {"code": "pipe_in_on",
+         "detail": "forced" if _pipe_req else "auto (2*in+work fits)"}
+        if use_pipe else
+        {"code": "pipe_in_off",
+         "detail": ("pipeline_dmas=False requested" if _pipe_req is False
+                    else "single grid step" if total_steps <= 1
+                    else "2*in+work over VMEM budget")})
     if use_pipe:
         tile_bytes = 2 * in_tile_bytes + work_bytes
         if tile_bytes > vmem_budget:   # explicitly-requested pipelining
@@ -946,6 +1023,53 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                                  + ostage_bytes <= vmem_budget)
     if use_pipe_out:
         tile_bytes += ostage_bytes
+    reasons.append(
+        {"code": "pipe_out_on",
+         "detail": "parity-doubled staging fits the budget"}
+        if use_pipe_out else
+        {"code": "pipe_out_off",
+         "detail": ("input pipelining off" if not use_pipe
+                    else "staging tiles over VMEM budget")})
+    if plan_only:
+        # The checker's window into the REAL planner: everything above
+        # ran (skew ladder, slab rounding, budget shrink, pipelining)
+        # but nothing traced or allocated.  Keys are plain
+        # JSON-serializable values.
+        return {
+            "fuse_steps": K,
+            "block": dict(block),
+            "grid": list(grid),
+            "total_steps": total_steps,
+            "skew": bool(use_skew),
+            "skew_dims": list(skew_dims),
+            "mL": dict(mL), "mR": dict(mR), "E": dict(E),
+            "radius": dict(rad),
+            "sizes": dict(sizes),
+            "minor": minor,
+            "sub_t": sub_t,
+            "lane_t": _lane_t,
+            "pipeline_dmas": use_pipe,
+            "pipeline_out": use_pipe_out,
+            "in_tile_bytes": in_tile_bytes,
+            "work_bytes": work_bytes,
+            "ostage_bytes": ostage_bytes if use_pipe_out else 0,
+            "carry_bytes": sum(
+                int(math.prod(carry_shape(d_, n_))) * esize
+                for (d_, n_) in carr_base),
+            "tile_bytes": tile_bytes,
+            "vmem_budget": vmem_budget,
+            "smem_vars": sorted(smem_vars),
+            "dma_vars": list(dma_vars),
+            "written": list(written),
+            "scratch_vars": list(scratch_vars),
+            "slots": dict(slots),
+            "carry_vars": list(carry_vars),
+            "tile_shapes": {n: list(tile_shape(n)) for n in var_order},
+            "base_off": {f"{n}/{d}": v for (n, d), v in base_off.items()},
+            "resid": {f"{n}/{d}": v for (n, d), v in resid.items()},
+            "slab": {f"{n}/{d}": v for (n, d), v in slab.items()},
+            "reasons": list(reasons),
+        }
     minor_origin = {n: (g.pads[minor][0]
                         if minor in g.domain_dims else 0)
                     for n, g in program.geoms.items()}
@@ -1570,7 +1694,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         # roughly double it.
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("arbitrary",) * len(grid),
-            vmem_limit_bytes=int(min(128 * 2 ** 20, 2 * vmem_budget)))
+            vmem_limit_bytes=vmem_limit_bytes(vmem_budget))
 
     call = pl.pallas_call(
         kernel,
@@ -1657,7 +1781,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                     "pipeline_out": use_pipe_out,
                     "tile_bytes": tile_bytes,
                     "margin_overhead":
-                        round(_computed / max(_useful, 1) - 1, 4)}
+                        round(_computed / max(_useful, 1) - 1, 4),
+                    "reasons": list(reasons)}
     return chunk, tile_bytes
 
 

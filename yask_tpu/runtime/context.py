@@ -243,13 +243,17 @@ class StencilContext:
     # prepare
     # ------------------------------------------------------------------
 
-    def prepare_solution(self) -> None:
-        """Setup pipeline (reference ``prepare_solution``,
-        ``soln_apis.cpp:137-250``): settings adjustment → decomposition →
-        var geometry → state allocation."""
-        for h in self._hooks["before_prepare"]:
-            h(self)
-        self._ended = False
+    def _plan_geometry(self):
+        """Settings adjustment → mode resolution → var-geometry planning,
+        WITHOUT allocating any state or marking the context prepared.
+
+        Returns the planned :class:`StepProgram`.  ``prepare_solution``
+        assigns it to ``self._program`` and allocates; the static
+        checker (``yask_tpu.checker``) calls this directly so a 512³
+        feasibility question never materializes gigabytes of state —
+        ``plan()`` is pure geometry (``alloc_state`` is a separate
+        step).  Sets ``self._mode`` / ``self._plan_kwargs`` but NOT
+        ``self._program`` (``is_prepared()`` keys off the latter)."""
         ndev = self._env.get_num_ranks()
         self._opts.adjust_settings(ndev)
 
@@ -310,7 +314,17 @@ class StencilContext:
         self._plan_kwargs = dict(extra_pad=extra, pad_multiple=pad_mult,
                                  mosaic_align=mode in ("pallas",
                                                        "shard_pallas"))
-        self._program = self._csol.plan(gsizes, **self._plan_kwargs)
+        return self._csol.plan(gsizes, **self._plan_kwargs)
+
+    def prepare_solution(self) -> None:
+        """Setup pipeline (reference ``prepare_solution``,
+        ``soln_apis.cpp:137-250``): settings adjustment → decomposition →
+        var geometry → state allocation."""
+        for h in self._hooks["before_prepare"]:
+            h(self)
+        self._ended = False
+        self._program = self._plan_geometry()
+        mode = self._mode
         self._resident = None
         self._state = self._program.alloc_state()
         self._state_on_device = True

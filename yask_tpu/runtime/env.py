@@ -24,7 +24,9 @@ class yk_env:
 
     def __init__(self, devices: Optional[List] = None):
         import jax
-        self._devices = list(devices) if devices is not None else jax.devices()
+        # the ONE library-level device query; drivers probe first
+        self._devices = (list(devices) if devices is not None
+                         else jax.devices())  # lint: devices-ok
         self._trace = False
         self._debug = sys.stdout
         self._msg_rank = 0

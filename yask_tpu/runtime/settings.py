@@ -98,6 +98,13 @@ class KernelSettings:
         # current plan (max observed 281k for iso3dfd-256-K2).
         # 0 disables the cap.
         self.max_tile_vinstr = 300_000
+        # Run the static checker (yask_tpu.checker) as a preflight in
+        # the driver tools (bench.py, tools/tpu_session.py) before
+        # spending wall-clock — or a scarce relay window — on a
+        # configuration the checker can prove infeasible (the round-3
+        # VMEM-OOM class).  Findings print; the launch proceeds (a
+        # checker false-positive must not cost a hardware window).
+        self.preflight = True
         # Misc.
         self.max_threads = 0           # accepted for parity; XLA manages
         self.numa_pref = -1            # accepted for parity
@@ -172,6 +179,11 @@ class KernelSettings:
             "max_vinstr", "Cap on estimated Mosaic vector instructions "
             "per fused kernel (tile-planner growth guard; 0 = off).",
             self, "max_tile_vinstr")
+        parser.add_bool_option(
+            "preflight", "Run the static checker (yask_tpu.checker) "
+            "before launching in the driver tools; findings print, "
+            "the launch proceeds (-no-preflight to skip).",
+            self, "preflight")
         parser.add_int_option(
             "max_threads", "Accepted for reference parity.", self,
             "max_threads")
