@@ -6,8 +6,8 @@ PY ?= python
 TEST_ENV ?= PALLAS_AXON_POOL_IPS=
 
 .PHONY: all native capi test test-fast scratch-tests boundary-tests \
-        stages-tests mode-tests bench perfcheck examples clean \
-        list-stencils lint check
+        stages-tests mode-tests bench perfcheck faultcheck examples \
+        clean list-stencils lint check
 
 all: native test
 
@@ -63,6 +63,13 @@ check:
 # unexplained breach (see tools/perfcheck.py; ledger = PERF_LEDGER.jsonl)
 perfcheck: lint
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) tools/perfcheck.py
+
+# the resilience layer end-to-end on the CPU mesh: fault taxonomy /
+# guards / journal units plus the injected relay-drop resume and
+# all-zero quarantine acceptance paths (see docs/resilience.md)
+faultcheck: lint
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_resilience.py -q
 
 examples:
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) examples/swe_main.py

@@ -18,18 +18,24 @@ vs_baseline is measured against the BASELINE.md target of 500 GPts/s/chip.
 
 import json
 import os
-import subprocess
 import sys
 import time
+
+from yask_tpu.resilience import (Fault, anomaly_fields, check_output,
+                                 guarded_call, maybe_corrupt,
+                                 python_cmd, run_deadlined)
 
 
 def _probe_platform(default_timeout: float = 240.0):
     """Decide the jax platform WITHOUT risking a hang in this process.
 
     The default backend dials a TPU relay that, when unreachable, hangs
-    for minutes inside backend init — so the probe runs in a subprocess
-    under a timeout.  Returns the backend name ('tpu', 'cpu', ...) or
-    None when the default backend is unusable.
+    for minutes inside backend init — so the probe runs in a killable
+    subprocess (yask_tpu.resilience.run_deadlined: process group + hard
+    kill, because subprocess.run(timeout=) can block forever in
+    communicate() when the backend plugin spawns a grandchild that
+    keeps the pipe open).  Returns the backend name ('tpu', 'cpu', ...)
+    or None when the default backend is unusable.
     """
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         return "cpu"  # explicit CPU: no probe needed, it can't hang
@@ -42,27 +48,17 @@ def _probe_platform(default_timeout: float = 240.0):
     except ValueError:
         timeout = default_timeout
     code = "import jax; print('PLATFORM=' + jax.default_backend())"
-    # Popen + process group + hard kill: subprocess.run(timeout=) can
-    # block forever in communicate() when the backend plugin spawns a
-    # grandchild that keeps the pipe open after the child is killed.
     try:
-        proc = subprocess.Popen(
-            [sys.executable, "-c", code],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-            text=True, start_new_session=True)
-        try:
-            out, _ = proc.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            import signal
-            os.killpg(proc.pid, signal.SIGKILL)
-            proc.wait()  # reap; cannot block after SIGKILL of the group
-            os.environ["YT_PROBED_PLATFORM"] = ""  # cache the failure
-            return None
+        _, out = run_deadlined(python_cmd(code), timeout,
+                               site="bench.probe")
         for line in (out or "").splitlines():
             if line.startswith("PLATFORM="):
                 plat = line.split("=", 1)[1].strip()
                 os.environ["YT_PROBED_PLATFORM"] = plat
                 return plat
+    except Fault:
+        os.environ["YT_PROBED_PLATFORM"] = ""  # cache the failure
+        return None
     except Exception:
         pass
     return None
@@ -96,8 +92,12 @@ def _reexec_on_cpu():
               env)
 
 
-_TPU_RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "TPU_RESULTS.jsonl")
+def _tpu_results_path() -> str:
+    """TPU_RESULTS.jsonl location (``YT_TPU_RESULTS`` overrides — the
+    fault-injection tests exercise the recording path on CPU without
+    touching the real artifact)."""
+    return os.environ.get("YT_TPU_RESULTS") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "TPU_RESULTS.jsonl")
 
 
 def _record_tpu_result(line: dict) -> None:
@@ -108,7 +108,7 @@ def _record_tpu_result(line: dict) -> None:
         rec = dict(line)
         rec["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime())
-        with open(_TPU_RESULTS, "a") as f:
+        with open(_tpu_results_path(), "a") as f:
             f.write(json.dumps(rec) + "\n")
     except Exception:
         pass
@@ -118,15 +118,18 @@ def _last_tpu_result():
     """Newest END-TO-END hardware-measured record (falls back to the
     newest per-chunk microbench when no end-to-end record exists —
     chunk timings exclude host/trial overhead and are not directly
-    comparable). Never fatal."""
+    comparable). Quarantined rows (sanity-guard anomalies: all-zero /
+    non-finite fields) never surface as "last measured". Never fatal."""
     newest = newest_chunk = newest_iso_chunk = None
     try:
-        with open(_TPU_RESULTS) as f:
+        with open(_tpu_results_path()) as f:
             for ln in f:
                 ln = ln.strip()
                 if not ln:
                     continue
                 rec = json.loads(ln)
+                if rec.get("quarantined"):
+                    continue   # anomalous data must not resurface
                 m = rec.get("metric", "")
                 if " chunk " in m:
                     newest_chunk = rec   # file order == time order
@@ -157,8 +160,7 @@ def build(fac, env, g, mode="jit", wf=0, radius=8):
     return ctx
 
 
-def measure(ctx, g, steps_per_trial, trials):
-    import numpy as np
+def measure(ctx, g, steps_per_trial, trials, sanity=None):
     # warmup (compile)
     ctx.run_solution(0, steps_per_trial - 1)
     rates = []
@@ -169,11 +171,23 @@ def measure(ctx, g, steps_per_trial, trials):
         dt = time.perf_counter() - t0
         t += steps_per_trial
         rates.append(g ** 3 * steps_per_trial / dt / 1e9)
+    # result-sanity guard on the interior slice around the impulse
+    # (nonzero after any step on a live device): all-zero / NaN fields
+    # must never yield a clean throughput number.  With a ``sanity``
+    # dict the verdict is returned for the caller to quarantine the row
+    # (the contract line still prints, labeled ANOMALY); without one a
+    # bad verdict raises, so pallas candidates and re-measures reject.
     s = ctx.get_var("pressure").get_elements_in_slice(
         [t, g // 2 - 1, g // 2 - 1, g // 2 - 1],
         [t, g // 2 + 1, g // 2 + 1, g // 2 + 1])
-    if not np.isfinite(s).all():
-        raise RuntimeError("non-finite field")
+    s = maybe_corrupt("bench.result", s)
+    verdict = check_output(s)
+    if sanity is not None:
+        sanity.clear()
+        sanity.update(verdict)
+    elif not verdict["ok"]:
+        raise RuntimeError("result anomaly: "
+                           + ",".join(verdict["anomalies"]))
     rates.sort()
     return rates[len(rates) // 2]
 
@@ -212,9 +226,10 @@ def _run_suite_rows():
     BENCH_suite_latest.json so the round artifact records the suite, not
     one number (VERDICT r2 weak 6).
 
-    Runs in a subprocess under a hard (process-group) kill so a hung
-    section can never forfeit the already-measured contract line — the
-    same isolation pattern as ``_probe_platform``. Never fatal."""
+    Runs under yask_tpu.resilience.run_deadlined (process-group hard
+    kill) so a hung section can never forfeit the already-measured
+    contract line; on deadline the rows measured before the hang are
+    drained — a partial suite beats losing everything. Never fatal."""
     if os.environ.get("YT_BENCH_SUITE", "1") != "1":
         return
     try:
@@ -224,23 +239,14 @@ def _run_suite_rows():
     suite = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "tools", "bench_suite.py")
     try:
-        proc = subprocess.Popen(
-            [sys.executable, suite], stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL, text=True, start_new_session=True)
         try:
-            out, _ = proc.communicate(timeout=budget)
-        except subprocess.TimeoutExpired:
-            import signal
-            os.killpg(proc.pid, signal.SIGKILL)
-            try:
-                # drain the rows measured before the hang — a partial
-                # suite beats losing everything to the kill
-                out, _ = proc.communicate(timeout=5)
-            except Exception:
-                out = ""
-            out = (out or "") + "\n" + json.dumps(
-                {"metric": "bench_suite timeout", "value": 0.0,
-                 "unit": "error"})
+            _, out = run_deadlined([sys.executable, suite], budget,
+                                   site="bench.suite")
+        except Fault as f:
+            out = (getattr(f, "partial_stdout", "") or "") + "\n" \
+                + json.dumps({"metric": "bench_suite timeout",
+                              "value": 0.0, "unit": "error",
+                              "fault": f.kind})
         for line in (out or "").splitlines():
             if line.strip():
                 print(line, flush=True)
@@ -283,8 +289,21 @@ def main():
     last_err = None
     for g in sizes:
         try:
+            sanity = {}
             ctx = build(fac, env, g, "jit")
-            rate = measure(ctx, g, steps_per_trial, trials)
+            # deadline around the in-process device work: the probe only
+            # proves the backend ANSWERED — a relay that dies after init
+            # would otherwise hang run_solution inside this process with
+            # nothing to kill it (the driver's outer timeout then loses
+            # the whole artifact, not one size)
+            try:
+                ddl = float(os.environ.get("YT_BENCH_MEASURE_DEADLINE",
+                                           "900"))
+            except ValueError:
+                ddl = 900.0
+            rate = guarded_call(measure, ctx, g, steps_per_trial, trials,
+                                site="bench.measure", deadline_secs=ddl,
+                                sanity=sanity)
             mode = "jit"
             bytes_pp = sum(ctx.hbm_model_bytes_pp())
             hbm_peak = env.get_hbm_peak_bytes_per_sec()
@@ -294,7 +313,12 @@ def main():
             want_pallas = os.environ.get(
                 "YT_BENCH_PALLAS", "1" if on_tpu else "0")
             if want_pallas == "1":
-                p = try_pallas(fac, env, g, steps_per_trial, trials)
+                # no deadline here: try_pallas isolates each K candidate
+                # with its own try/except, which would swallow the alarm
+                # — the site still classifies faults + takes injection
+                p = guarded_call(try_pallas, fac, env, g,
+                                 steps_per_trial, trials,
+                                 site="bench.pallas")
                 if p is not None and p[0] > rate:
                     rate, mode = p[0], f"pallas-K{p[1]}"
                     bytes_pp = p[2]   # model of the winning kernel
@@ -333,7 +357,7 @@ def main():
                     prov, roofline=roof,
                     extra={"mode": mode,
                            "vs_baseline": round(rate / 500.0, 4)},
-                    remeasure=remeasure)
+                    remeasure=remeasure, sanity=sanity)
                 guard = lrow["guard"]
             except Exception:
                 pass  # ledger I/O must never cost the contract line
@@ -353,6 +377,12 @@ def main():
             }
             if roof.get("roofline_frac") is not None:
                 line["hbm_roofline"] = roof["roofline_frac"]
+            if sanity and not sanity.get("ok", True):
+                # the contract line survives but labeled: an all-zero /
+                # NaN field is an ANOMALY row, quarantined everywhere
+                # (excluded from sentinel baselines and never surfaced
+                # by _last_tpu_result)
+                line.update(anomaly_fields(sanity))
             if on_tpu:
                 _record_tpu_result(line)
             else:

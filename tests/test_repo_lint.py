@@ -96,6 +96,93 @@ def test_ordinary_eq_in_expr_suffix_name_only(tmp_path):
     assert fs == []
 
 
+def lint_tool(tmp_path, src, name="tools/t.py"):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return repo_lint.lint_file(str(p), str(tmp_path))
+
+
+def test_bare_device_call_fires_in_driver_scope(tmp_path):
+    src = """\
+        def main(ctx):
+            ctx.run_solution(0, 9)
+    """
+    assert fired(lint_tool(tmp_path, src)) == ["BARE-DEVICE-CALL"]
+    assert fired(lint_tool(tmp_path, src, name="bench.py")) \
+        == ["BARE-DEVICE-CALL"]
+    # library / test code is out of scope: the rule is about driver
+    # artifacts that run unattended against the relay
+    assert fired(lint_tool(tmp_path, src, name="yask_tpu/x.py")) == []
+
+
+def test_bare_device_call_sanctioned_via_guarded_name(tmp_path):
+    fs = lint_tool(tmp_path, """\
+        def measure(ctx):
+            ctx.run_solution(0, 9)
+            return ctx.compare_data(ctx)
+
+        def main(ctx):
+            return guarded_call(measure, ctx, site="bench.measure")
+    """)
+    assert fs == []
+
+
+def test_bare_device_call_transitive_closure(tmp_path):
+    # the guarded root calls a helper; the helper's device work is
+    # sanctioned through the call-graph closure
+    fs = lint_tool(tmp_path, """\
+        def helper(ctx):
+            ctx.run_solution(0, 9)
+
+        def sect(ctx):
+            helper(ctx)
+
+        def main(ctx):
+            section(sect)
+    """)
+    assert fs == []
+
+
+def test_bare_device_call_factory_arg(tmp_path):
+    # run_case(stage, case, make_body(...)): the factory's nested body
+    # runs under the guard
+    fs = lint_tool(tmp_path, """\
+        def make_body(ctx):
+            def body():
+                ctx.run_solution(0, 9)
+            return body
+
+        def main(runner, ctx):
+            runner.run_case("validate", "cube", make_body(ctx))
+    """)
+    assert fs == []
+
+
+def test_bare_device_call_unguarded_sibling_still_fires(tmp_path):
+    fs = lint_tool(tmp_path, """\
+        def guarded_fn(ctx):
+            ctx.run_solution(0, 9)
+
+        def bare_fn(ctx):
+            ctx.run_solution(0, 9)
+
+        def main(ctx):
+            guarded_call(guarded_fn, ctx, site="x")
+            bare_fn(ctx)
+    """)
+    assert fired(fs) == ["BARE-DEVICE-CALL"]
+    assert fs[0]["line"] == 5
+
+
+def test_bare_device_call_pragma(tmp_path):
+    fs = lint_tool(tmp_path, """\
+        def main(ctx):
+            ctx.run_solution(0, 9)  # lint: bare-device-call-ok
+    """)
+    assert fs == []
+
+
 def test_repo_is_clean():
     findings = repo_lint.run_lint([ROOT], root=ROOT)
     assert findings == [], findings

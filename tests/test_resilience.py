@@ -1,0 +1,415 @@
+"""yask_tpu.resilience: fault taxonomy / guards / journal / sanity /
+watch units, plus the two end-to-end acceptance paths (also the
+``make faultcheck`` target): an injected relay drop mid-session whose
+rerun resumes from the journal, and an injected all-zero output that
+can only ever produce a quarantined ANOMALY row.
+
+Everything runs on CPU: the injection plan (``YT_FAULT_PLAN``) drives
+the faults, so the machinery that guards rare hardware windows is
+tested without hardware.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from yask_tpu.resilience import (Breaker, CompileFailed, CompilerOOM,
+                                 DeviceHang, Fault, RelayDown,
+                                 SessionJournal, TERMINAL_OUTCOMES,
+                                 anomaly_fields, array_stats,
+                                 check_output, classify,
+                                 classify_message, deadline,
+                                 fault_point, guarded_call,
+                                 maybe_corrupt, python_cmd,
+                                 reset_faults, run_deadlined)
+from yask_tpu.resilience import watch
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan(monkeypatch):
+    monkeypatch.delenv("YT_FAULT_PLAN", raising=False)
+    reset_faults()
+    yield
+    reset_faults()
+
+
+# ---------------------------------------------------------------- taxonomy
+
+def test_classify_messages():
+    assert classify_message("INTERNAL: stream terminated by RST_STREAM") \
+        is RelayDown
+    assert classify_message("UNAVAILABLE: failed to connect") is RelayDown
+    assert classify_message("Mosaic lowering failed") is CompileFailed
+    assert classify_message("some totally unrelated KeyError") is None
+
+
+def test_classify_oom_wins_over_compile_signs():
+    # a Mosaic OOM message also carries INTERNAL/Mosaic signatures;
+    # the OOM test must win (the round-3 tuner postmortem ordering)
+    msg = ("INTERNAL: Mosaic failed: RESOURCE_EXHAUSTED: Ran out of "
+           "memory in memory space vmem")
+    assert classify_message(msg) is CompilerOOM
+
+
+def test_classify_wraps_and_passes_through():
+    f = classify(RuntimeError("Connection reset by peer"), site="s")
+    assert isinstance(f, RelayDown) and f.site == "s"
+    assert isinstance(f.cause, RuntimeError)
+    inj = RelayDown("injected", site="x")
+    assert classify(inj) is inj          # Fault instances pass through
+    assert classify(KeyError("bug")) is None   # our bugs stay ours
+
+
+def test_breaker():
+    b = Breaker(threshold=2)
+    assert not b.record(RelayDown("one"))
+    assert not b.tripped
+    assert b.record(RelayDown("two")) and b.tripped
+    assert b.last.kind == "relay_down"
+    b.reset()
+    assert not b.tripped and b.consecutive == 0
+
+
+# ---------------------------------------------------------------- injection
+
+def test_fault_plan_compact_parse(monkeypatch):
+    monkeypatch.setenv("YT_FAULT_PLAN",
+                       "a.*:relay_down:2:1; b:zero_output")
+    from yask_tpu.resilience.faults import active_plan
+    plan = active_plan()
+    assert plan[0]["site"] == "a.*" and plan[0]["times"] == 2 \
+        and plan[0]["after"] == 1
+    assert plan[1] == {"site": "b", "kind": "zero_output", "times": 1,
+                       "after": 0, "secs": 3600.0, "_seen": 0}
+
+
+def test_fault_plan_rejects_unknown_kind(monkeypatch):
+    monkeypatch.setenv("YT_FAULT_PLAN", "a:frobnicate")
+    from yask_tpu.resilience.faults import active_plan
+    with pytest.raises(ValueError):
+        active_plan()
+
+
+def test_fault_point_fires_by_glob_and_window(monkeypatch):
+    monkeypatch.setenv("YT_FAULT_PLAN", "s.*:relay_down:1:1")
+    fault_point("s.one")                 # hit 1 <= after: no fire
+    with pytest.raises(RelayDown):
+        fault_point("s.two")             # hit 2: fires
+    fault_point("s.three")               # window exhausted
+    fault_point("other")                 # never matched the glob
+
+
+def test_injected_faults_carry_classifiable_signatures(monkeypatch):
+    # injected messages must round-trip through classify_message, so
+    # code that sniffs messages (not isinstance) behaves identically
+    # under injection and under the real backend
+    for kind, cls in (("relay_down", RelayDown),
+                      ("compiler_oom", CompilerOOM)):
+        monkeypatch.setenv("YT_FAULT_PLAN", f"p.{kind}:{kind}")
+        reset_faults()
+        with pytest.raises(cls) as ei:
+            fault_point(f"p.{kind}")
+        assert classify_message(str(ei.value)) is cls
+
+
+def test_maybe_corrupt(monkeypatch):
+    import numpy as np
+    monkeypatch.setenv("YT_FAULT_PLAN",
+                       "z:zero_output; n:nan_output")
+    a = np.ones((3, 3), np.float32)
+    z = maybe_corrupt("z", a)
+    assert (z == 0).all() and (a == 1).all()   # copy, not in-place
+    state = {"v": [np.ones(4)]}
+    n = maybe_corrupt("n", state)
+    assert np.isnan(n["v"][0]).all()
+    assert maybe_corrupt("unmatched", a) is a
+
+
+# ---------------------------------------------------------------- guards
+
+def test_guarded_call_classifies_and_keeps_own_bugs(monkeypatch):
+    def boom():
+        raise RuntimeError("UNAVAILABLE: failed to connect")
+    with pytest.raises(RelayDown):
+        guarded_call(boom, site="t.relay")
+
+    def bug():
+        raise KeyError("ours")
+    with pytest.raises(KeyError):        # unclassified: untouched
+        guarded_call(bug, site="t.bug")
+
+
+def test_guarded_call_retries_then_succeeds(monkeypatch):
+    monkeypatch.setenv("YT_FAULT_PLAN", "t.retry:relay_down:1")
+    calls = []
+    out = guarded_call(lambda: calls.append(1) or "ok", site="t.retry",
+                       retries=2, backoff=0.01, max_backoff=0.01,
+                       jitter=0.0)
+    assert out == "ok" and calls == [1]
+
+
+def test_guarded_call_breaker_suppresses_retry(monkeypatch):
+    monkeypatch.setenv("YT_FAULT_PLAN", "t.brk:relay_down:9")
+    b = Breaker(threshold=1)
+    t0 = time.perf_counter()
+    with pytest.raises(RelayDown):
+        guarded_call(lambda: "never", site="t.brk", retries=5,
+                     backoff=5.0, breaker=b)
+    assert time.perf_counter() - t0 < 2.0   # no backoff sleeps happened
+    assert b.tripped
+
+
+def test_guarded_call_breaker_resets_on_success():
+    b = Breaker(threshold=3)
+    b.record(RelayDown("x"))
+    assert guarded_call(lambda: 7, site="t.ok", breaker=b) == 7
+    assert b.consecutive == 0
+
+
+def test_deadline_converts_hang(monkeypatch):
+    monkeypatch.setenv("YT_FAULT_PLAN", "t.hang:hang")
+    from yask_tpu.resilience.faults import _entries
+    _entries()[0]["secs"] = 5.0          # shorten the injected stall
+    with pytest.raises(DeviceHang):
+        guarded_call(lambda: None, site="t.hang", deadline_secs=0.3)
+
+
+def test_deadline_noop_when_off():
+    with deadline(None, site="x"):
+        pass
+    with deadline(0.2, site="x"):
+        time.sleep(0.01)                 # finishes before the alarm
+
+
+def test_run_deadlined_ok_and_kill():
+    rc, out = run_deadlined(python_cmd("print('hello')"), 30,
+                            site="t.sub")
+    assert rc == 0 and out.strip() == "hello"
+    with pytest.raises(DeviceHang) as ei:
+        run_deadlined(python_cmd(
+            "import sys, time; print('partial', flush=True); "
+            "time.sleep(60)"), 1.0, site="t.sub")
+    assert "partial" in (ei.value.partial_stdout or "")
+
+
+# ---------------------------------------------------------------- journal
+
+def test_journal_roundtrip_and_resume(tmp_path):
+    j = SessionJournal(str(tmp_path / "J.jsonl"))
+    j.record("validate", "a", "started", attempt=1)
+    j.record("validate", "a", "ok", attempt=1, mismatches=0)
+    j.record("validate", "b", "started", attempt=1)
+    j.record("validate", "b", "fault", attempt=1, kind="relay_down")
+    j.record("validate", "c", "anomaly", anomalies=["all_zero"])
+    assert j.completed("validate", "a")
+    assert not j.completed("validate", "b")
+    assert j.completed("validate", "c")   # anomaly is terminal
+    assert j.pending("validate", ["a", "b", "c", "d"]) == ["b", "d"]
+    assert j.attempts("validate", "b") == 1
+    assert j.last_outcomes()[("validate", "b")]["outcome"] == "fault"
+
+
+def test_journal_skips_malformed_lines(tmp_path):
+    p = tmp_path / "J.jsonl"
+    j = SessionJournal(str(p))
+    j.record("s", "c", "ok")
+    with open(p, "a") as f:
+        f.write("{truncated mid-wri\n")   # kill mid-write
+    assert len(j.rows()) == 1
+
+
+def test_journal_compact(tmp_path):
+    j = SessionJournal(str(tmp_path / "J.jsonl"))
+    j.record("session", "", "started")
+    j.record("validate", "a", "started")
+    j.record("validate", "a", "ok")
+    j.record("session", "", "ok")
+    dropped = j.compact()
+    assert dropped == 2
+    rows = j.rows()
+    assert [(r["stage"], r["case"], r["outcome"]) for r in rows] == [
+        ("session", "", "ok"), ("validate", "a", "ok")]
+    assert j.completed("validate", "a")
+
+
+# ---------------------------------------------------------------- sanity
+
+def test_check_output_verdicts():
+    import numpy as np
+    ok = check_output(np.linspace(1, 2, 64))
+    assert ok["ok"] and ok["anomalies"] == []
+    z = check_output(np.zeros(64))
+    assert not z["ok"] and "all_zero" in z["anomalies"]
+    nf = check_output(np.array([1.0, np.nan]))
+    assert "nonfinite" in nf["anomalies"]
+    m = check_output(np.ones(8), oracle=np.full(8, 2.0))
+    assert "oracle_mismatch" in m["anomalies"]
+    assert m["oracle_rel_err"] > 0.4
+    shp = check_output(np.ones(8), oracle=np.ones(9))
+    assert "oracle_shape_mismatch" in shp["anomalies"]
+    good = check_output(np.ones(8), oracle=np.ones(8) * 1.001)
+    assert good["ok"]
+
+
+def test_array_stats_over_state_dict():
+    import numpy as np
+    st = array_stats({"v": [np.zeros(4), np.array([1.0, -3.0])]})
+    assert st["n"] == 6 and st["max_abs"] == 3.0
+    assert abs(st["zero_frac"] - 4 / 6) < 1e-12
+
+
+def test_anomaly_fields_shape():
+    v = check_output(__import__("numpy").zeros(16))
+    af = anomaly_fields(v)
+    assert af["quarantined"] is True
+    assert af["anomaly"]["classification"] == "ANOMALY"
+    assert af["anomaly"]["anomalies"] == ["all_zero"]
+
+
+def test_sentinel_excludes_quarantined_rows():
+    from yask_tpu.perflab.sentinel import is_clean
+    clean = {"value": 1.0, "guard": {"status": "ok"}, "source": "bench"}
+    assert is_clean(clean)
+    assert not is_clean({**clean, "quarantined": True})
+    assert not is_clean({**clean, "guard": {"status": "anomaly"}})
+
+
+def test_last_tpu_result_skips_quarantined(tmp_path, monkeypatch):
+    monkeypatch.setenv("YT_TPU_RESULTS", str(tmp_path / "T.jsonl"))
+    sys.path.insert(0, ROOT)
+    import bench
+    bench._record_tpu_result({"metric": "iso3dfd r=8 512^3 tpu",
+                              "value": 2.5, "unit": "GPts/s"})
+    bench._record_tpu_result({"metric": "iso3dfd r=8 512^3 tpu",
+                              "value": 0.0, "unit": "GPts/s",
+                              "quarantined": True,
+                              "anomaly": {"anomalies": ["all_zero"]}})
+    last = bench._last_tpu_result()
+    assert last is not None and last["value"] == 2.5
+
+
+# ---------------------------------------------------------------- watch
+
+def test_watch_session_args(tmp_path):
+    j = SessionJournal(str(tmp_path / "J.jsonl"))
+    # no journal at all: first window banks numbers fast
+    assert watch.session_args(j, g=256) == ["-g", "256", "--quick"]
+    # a dropped session leaves non-terminal work: resume (still quick —
+    # no session has ever completed)
+    j.record("session", "", "started")
+    j.record("validate", "a", "started")
+    assert watch.session_args(j) == ["-g", "512", "--quick", "--resume"]
+    # everything terminal + a completed session: plain full run
+    j.record("validate", "a", "ok")
+    j.record("session", "", "ok")
+    assert watch.session_args(j) == ["-g", "512"]
+
+
+def test_watch_relay_up_probe_override():
+    assert watch.relay_up(probe_cmd=python_cmd("raise SystemExit(0)"))
+    assert not watch.relay_up(probe_cmd=python_cmd("raise SystemExit(3)"))
+    assert not watch.relay_up(
+        timeout=1.0,
+        probe_cmd=python_cmd("import time; time.sleep(60)"))
+
+
+# ------------------------------------------------------------- acceptance
+
+def _session_env(tmp_path, **extra):
+    env = dict(os.environ)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "YT_TPU_SESSION_FORCE": "1",
+        "YT_SESSION_JOURNAL": str(tmp_path / "JOURNAL.jsonl"),
+        "YT_TPU_RESULTS": str(tmp_path / "TPU_RESULTS.jsonl"),
+        "YT_PERF_LEDGER": str(tmp_path / "LEDGER.jsonl"),
+    })
+    env.pop("YT_FAULT_PLAN", None)
+    env.update(extra)
+    return env
+
+
+def _run_session(env, *args):
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpu_session.py"),
+         *args],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=600)
+
+
+def test_acceptance_relay_drop_resumes_from_journal(tmp_path):
+    """Injected relay drop mid-matrix on the CPU mesh: the rerun must
+    complete ONLY the missing case (the ISSUE acceptance criterion)."""
+    env = _session_env(
+        tmp_path,
+        YT_SESSION_MATRIX="3axis:1,cube:1",
+        YT_FAULT_PLAN="session.validate.cube:relay_down:9")
+    r1 = _run_session(env, "--stages", "validate")
+    j = SessionJournal(env["YT_SESSION_JOURNAL"])
+    assert j.completed("validate", "3axis"), r1.stdout + r1.stderr
+    assert not j.completed("validate", "cube")
+
+    env.pop("YT_FAULT_PLAN")             # relay "came back"
+    r2 = _run_session(env, "--stages", "validate", "--resume")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    j2 = SessionJournal(env["YT_SESSION_JOURNAL"])
+    assert j2.completed("validate", "cube")
+    # 3axis was NOT re-run: still exactly one attempt journaled
+    assert j2.attempts("validate", "3axis") == 1
+    assert j2.attempts("validate", "cube") == 2
+
+
+def test_acceptance_all_zero_output_quarantined(tmp_path):
+    """Injected all-zero chunk outputs must never produce a clean
+    ledger / TPU_RESULTS row (the ISSUE acceptance criterion; the
+    round-3 all-zero quick-matrix incident, replayed)."""
+    from yask_tpu.perflab.sentinel import is_clean
+    env = _session_env(
+        tmp_path,
+        YT_SESSION_BANK="1",
+        YT_FAULT_PLAN="session.chunk_result:zero_output:99")
+    # journal every chunk_abs case but pipeline_ab as already done, so
+    # --resume runs exactly one A/B (keeps the CPU-interpret run short)
+    j = SessionJournal(env["YT_SESSION_JOURNAL"])
+    for c in ("skew_ab.K2", "skew_ab.K4", "vmem_ladder", "esk_ab",
+              "bf16_ab"):
+        j.record("chunk_abs", c, "skip", reason="test pre-seed")
+    r = _run_session(env, "-g", "64", "--stages", "chunk_abs",
+                     "--resume")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    rows = [json.loads(ln) for ln in
+            open(env["YT_TPU_RESULTS"]).read().splitlines() if ln]
+    assert rows, r.stdout + r.stderr
+    assert all(row.get("quarantined") for row in rows)
+    led = [json.loads(ln) for ln in
+           open(env["YT_PERF_LEDGER"]).read().splitlines() if ln]
+    assert led and all(row.get("quarantined") for row in led)
+    assert not any(is_clean(row) for row in led)
+    # the case completed, but as a journaled ANOMALY (terminal: resume
+    # will not burn a window re-measuring rejected data)
+    out = SessionJournal(
+        env["YT_SESSION_JOURNAL"]).last_outcomes()[
+            ("chunk_abs", "pipeline_ab")]
+    assert out["outcome"] == "anomaly"
+    assert "all_zero" in out["detail"]["anomalies"]
+
+
+# -------------------------------------------------------- halo-cal flag
+
+def test_yk_stats_halo_cal_unstable_flag():
+    from yask_tpu.runtime.stats import yk_stats
+    st = yk_stats(npts=8, nsteps=1, nreads_pp=1, nwrites_pp=1,
+                  nfpops_pp=1, elapsed=1.0, halo_cal_unstable=True)
+    assert st.get_halo_cal_unstable() is True
+    assert "halo-cal-unstable: true" in st.format()
+    st2 = yk_stats(npts=8, nsteps=1, nreads_pp=1, nwrites_pp=1,
+                   nfpops_pp=1, elapsed=1.0)
+    assert st2.get_halo_cal_unstable() is False
+    assert "halo-cal-unstable" not in st2.format()

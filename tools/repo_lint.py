@@ -16,12 +16,23 @@ Rules (see docs/checking.md for the catalog):
   the axon TPU relay and can hang a driver artifact for minutes; only
   the killable-subprocess probes (``_probe_platform``, ``_ready``) and
   explicitly pragma'd TPU-session tools may touch it.
+* ``BARE-DEVICE-CALL`` — device WORK (``run_solution`` /
+  ``block_until_ready`` / ``compare_data`` / ``run_auto_tuner_now``)
+  in a driver artifact (``bench.py``, ``tools/*.py``) outside any
+  resilience guard.  A relay that dies mid-run hangs such a call with
+  nothing to kill it; driver tools must route device work through
+  ``guarded_call`` / ``run_deadlined`` (or the suite/session wrappers
+  ``section`` / ``run_case`` that call them).  Sanctioning is a
+  transitive call-graph closure from the functions passed into those
+  invokers, so helpers like ``measure`` stay clean without pragmas.
+  Library code (``yask_tpu/``) is out of scope — the rule is about
+  unattended driver artifacts, not the API.
 
 Detection of "an Expr value" is lexical (this is a linter, not a type
 checker): names ``expr``/``lhs``/``rhs``/``eq``, the ``*_expr``
 suffix, and attribute access ``.lhs`` / ``.rhs``.  Escape hatch: put
 ``# lint: <rule>-ok`` on the flagged line (rule tokens: ``expr-eq``,
-``expr-key``, ``devices``).
+``expr-key``, ``devices``, ``bare-device-call``).
 
 Usage: ``python tools/repo_lint.py [paths...]`` — defaults to the
 repo root; exit 1 when anything fires.
@@ -43,6 +54,25 @@ EXPR_RULE_EXEMPT = {os.path.join("yask_tpu", "compiler", "expr.py")}
 _SUSPECT_NAMES = {"expr", "lhs", "rhs", "eq"}
 _SUSPECT_ATTRS = {"lhs", "rhs"}
 _PROBE_FUNCS = {"_probe_platform", "_ready"}
+
+# ---- BARE-DEVICE-CALL ----------------------------------------------------
+#: methods/functions that put work on the device (and therefore hang
+#: when the relay dies mid-run)
+_DEVICE_WORK = {"run_solution", "block_until_ready", "compare_data",
+                "run_auto_tuner_now"}
+#: resilience entry points: a function passed (by name, or as a
+#: ``factory(...)`` call) into one of these runs under a deadline /
+#: classified-fault guard, and so does everything it calls
+_GUARD_INVOKERS = {"guarded_call", "run_deadlined", "section",
+                   "run_case", "run_stage", "guarded"}
+
+
+def _device_rule_in_scope(relpath: str) -> bool:
+    """Driver artifacts only: bench.py and the tools/ scripts run
+    unattended against the relay; library code is exercised under the
+    callers' guards."""
+    return (relpath == "bench.py"
+            or relpath.startswith("tools" + os.sep))
 
 
 def _is_expr_operand(node: ast.AST) -> bool:
@@ -137,6 +167,94 @@ class _Linter(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _call_name(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+class _DeviceCallPass(ast.NodeVisitor):
+    """Two-phase BARE-DEVICE-CALL check: collect the module call graph,
+    the guard roots (names passed into guard invokers), and every
+    device-work call site; then sanction sites whose lexically
+    enclosing function is reachable from a root through the call
+    graph.  Lexical and name-based — a linter, not a type checker —
+    but that is exactly how the driver tools are shaped (nested
+    section/case closures handed to ``run_case``/``section``)."""
+
+    def __init__(self):
+        self.calls: dict = {}      # enclosing func name -> called names
+        self.roots: set = set()    # names passed into guard invokers
+        self.sites: List[tuple] = []   # (node, enclosing-func stack)
+        self._stack: List[str] = []
+
+    def visit_FunctionDef(self, node):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        name = _call_name(node)
+        if name:
+            if self._stack:
+                self.calls.setdefault(self._stack[-1], set()).add(name)
+            if name in _GUARD_INVOKERS:
+                for a in list(node.args) + [kw.value
+                                            for kw in node.keywords]:
+                    if isinstance(a, ast.Name):
+                        self.roots.add(a.id)
+                    elif (isinstance(a, ast.Call)
+                          and isinstance(a.func, ast.Name)):
+                        # case factory: run_case(st, c, make_body(...))
+                        # — the factory's nested body runs guarded
+                        self.roots.add(a.func.id)
+            if name in _DEVICE_WORK:
+                self.sites.append((node, tuple(self._stack)))
+        self.generic_visit(node)
+
+    def guarded_funcs(self) -> set:
+        guarded = set(self.roots)
+        changed = True
+        while changed:
+            changed = False
+            for f in list(guarded):
+                for g in self.calls.get(f, ()):
+                    if g not in guarded:
+                        guarded.add(g)
+                        changed = True
+        return guarded
+
+
+def _lint_device_calls(tree: ast.AST, relpath: str,
+                       lines: List[str]) -> List[dict]:
+    p = _DeviceCallPass()
+    p.visit(tree)
+    guarded = p.guarded_funcs()
+    findings = []
+    for node, stack in p.sites:
+        if any(f in guarded for f in stack):
+            continue
+        line = (lines[node.lineno - 1]
+                if node.lineno - 1 < len(lines) else "")
+        if "# lint: bare-device-call-ok" in line:
+            continue
+        findings.append({
+            "rule": "BARE-DEVICE-CALL", "path": relpath,
+            "line": node.lineno,
+            "message": (f"device work ({_call_name(node)}) in a driver "
+                        "artifact outside any resilience guard — a "
+                        "dying relay hangs it with nothing to kill it; "
+                        "route through guarded_call/run_deadlined (or "
+                        "a section/run_case wrapper), or pragma a "
+                        "deliberate exception")})
+    return findings
+
+
 def lint_file(path: str, root: str) -> List[dict]:
     relpath = os.path.relpath(path, root)
     with open(path, encoding="utf-8") as f:
@@ -146,9 +264,13 @@ def lint_file(path: str, root: str) -> List[dict]:
     except SyntaxError as e:
         return [{"rule": "PARSE-ERROR", "path": relpath,
                  "line": e.lineno or 0, "message": str(e.msg)}]
-    linter = _Linter(relpath, src.splitlines())
+    lines = src.splitlines()
+    linter = _Linter(relpath, lines)
     linter.visit(tree)
-    return linter.findings
+    findings = linter.findings
+    if _device_rule_in_scope(relpath):
+        findings.extend(_lint_device_calls(tree, relpath, lines))
+    return findings
 
 
 def iter_py_files(paths: List[str], root: str):

@@ -20,10 +20,21 @@ a completed joint tune, and a tuned bench number (VERDICT r3 items
 5. report: a BENCH-style JSON line per stage (each perf row is
    persisted to TPU_RESULTS.jsonl the moment it is measured); then
 6. compile-time A/B of the ``max_vinstr`` tile cap on ssg/swe2d.
-Every stage is crash-isolated from the rest.
 
-Run: ``python tools/tpu_session.py [-g 512] [--quick]``
+Every stage is crash-isolated AND journaled (yask_tpu.resilience):
+each case appends its outcome to SESSION_JOURNAL.jsonl the moment it
+is known, ``--resume`` completes only the cases a dropped relay left
+unfinished, a consecutive-fault breaker aborts the session loudly when
+the relay dies mid-run, and every measured row passes the result-
+sanity guards (an all-zero field is banked as a quarantined ANOMALY
+row, never a clean number — the round-3 quick-matrix incident).
+
+Run: ``python tools/tpu_session.py [-g 512] [--quick] [--resume |
+--fresh] [--stages smoke,validate,...]``
 (needs the real backend: do NOT set JAX_PLATFORMS=cpu).
+``YT_SESSION_MATRIX="name:radius,..."`` ("-" = default radius)
+overrides the validation matrix; ``YT_SESSION_JOURNAL`` relocates the
+journal; ``YT_SESSION_BANK=1`` banks rows off-TPU (tests).
 """
 
 from __future__ import annotations
@@ -37,6 +48,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+from yask_tpu.resilience import (Breaker, Fault, SessionJournal,
+                                 TERMINAL_OUTCOMES, anomaly_fields,
+                                 check_output, guarded_call,
+                                 maybe_corrupt)
 
 MATRIX = [
     ("3axis", 1), ("cube", 1), ("iso3dfd", 2), ("iso3dfd_sponge", 2),
@@ -45,9 +60,61 @@ MATRIX = [
     ("test_boundary_3d", None), ("test_misc_2d", None),
 ]
 
+STAGES = ("smoke", "validate", "chunk_abs", "tune_bench", "compile_time")
+
+
+def matrix_cases():
+    """The validation matrix, overridable via YT_SESSION_MATRIX
+    ("name:radius,..." with "-" for the stencil's default radius) —
+    the resume acceptance test runs a 2-stencil matrix on the CPU
+    mesh instead of burning minutes on all 13."""
+    raw = os.environ.get("YT_SESSION_MATRIX", "").strip()
+    if not raw:
+        return list(MATRIX)
+    out = []
+    for part in raw.split(","):
+        name, _, rad = part.strip().partition(":")
+        out.append((name, None if rad in ("", "-") else int(rad)))
+    return out
+
 
 def log(stage, **kv):
     print(json.dumps({"stage": stage, **kv}), flush=True)
+
+
+def bank_row(plat, env, line, roofline=None, sanity=None):
+    """Persist one measured TPU row twice: bench.py's TPU_RESULTS.jsonl
+    (the ``last_tpu_measured`` contract fallback) and the unified perf
+    ledger (source ``tpu_session``) with provenance + a sentinel
+    verdict — relay windows are short, so every row is banked the
+    moment it exists.  A failed ``sanity`` verdict quarantines the row
+    in BOTH artifacts (structured ANOMALY, excluded from sentinel
+    baselines and from ``last_tpu_measured``)."""
+    line = dict(line)
+    if sanity and not sanity.get("ok", True):
+        line.update(anomaly_fields(sanity))
+    try:
+        from bench import _record_tpu_result
+        _record_tpu_result(line)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from yask_tpu.perflab import capture_provenance
+        from yask_tpu.perflab.sentinel import guard_and_append
+        prov = capture_provenance(
+            platform=plat,
+            device_kind=(getattr(env.get_devices()[0], "device_kind",
+                                 "") if env.get_devices() else ""))
+        extra = {k: v for k, v in line.items()
+                 if k not in ("metric", "value", "unit", "platform",
+                              "quarantined", "anomaly")}
+        guard_and_append(line["metric"], line["value"], line["unit"],
+                         plat, "tpu_session", prov,
+                         roofline=roofline, extra=extra or None,
+                         sanity=sanity)
+    except Exception as e:  # noqa: BLE001
+        log("ledger", error=str(e)[:160])
+    return line
 
 
 def build(fac, env, name, mode, g, radius, wf=1, block=None, tune=False,
@@ -79,16 +146,106 @@ def build(fac, env, name, mode, g, radius, wf=1, block=None, tune=False,
     return ctx
 
 
+def interior_slice(ctx):
+    """A small interior slice of the first var around the domain center
+    (seeded nonzero by init_solution_vars) — the sanity-guard probe."""
+    name = ctx.get_var_names()[0]
+    v = ctx.get_var(name)
+    t = ctx._cur_step
+    mid = [s // 2 for s in
+           (ctx.get_settings().global_domain_sizes[d]
+            for d in ctx.get_domain_dim_names())]
+    return v.get_elements_in_slice([t] + [c - 1 for c in mid],
+                                   [t] + [c + 1 for c in mid])
+
+
+class SessionRunner:
+    """Journal + breaker wiring around every stage/case: outcomes are
+    durable the moment they are known, ``--resume`` skips journaled
+    terminal cases, and ``breaker.threshold`` consecutive classified
+    faults abort the whole session (a dead relay must end it loudly,
+    not grind every remaining case against nothing)."""
+
+    def __init__(self, journal: SessionJournal, resume: bool,
+                 breaker: Breaker):
+        self.journal = journal
+        self.resume = resume
+        self.breaker = breaker
+        self.last_status = ""   # "skipped"|"fault"|terminal outcome
+
+    def pending(self, stage, cases):
+        if not self.resume:
+            return list(cases)
+        return self.journal.pending(stage, list(cases))
+
+    def run_case(self, stage, case, fn):
+        """One journaled case.  ``fn`` returning ``{"outcome":
+        "anomaly"|"skip", ...}`` selects a non-ok terminal outcome
+        (details journaled); any other return is outcome ``ok``."""
+        if self.resume and self.journal.completed(stage, case):
+            self.last_status = "skipped"
+            log(stage, case=case, skipped="journaled complete")
+            return None
+        attempt = self.journal.attempts(stage, case) + 1
+        self.journal.record(stage, case, "started", attempt=attempt)
+        site = f"session.{stage}" + (f".{case}" if case else "")
+        try:
+            out = guarded_call(fn, site=site, breaker=self.breaker)
+        except Fault as f:
+            self.last_status = "fault"
+            self.journal.record(stage, case, "fault", attempt=attempt,
+                                kind=f.kind, error=str(f)[:160])
+            log(stage, case=case, fault=f.kind, error=str(f)[:200])
+            if self.breaker.tripped:
+                self.journal.record(
+                    "session", "", "aborted",
+                    reason=f"{self.breaker.consecutive} consecutive "
+                           f"faults (last: {f.kind})")
+                raise
+            return None
+        except Exception as e:  # noqa: BLE001 - stage isolation
+            self.last_status = "fault"
+            self.journal.record(stage, case, "fault", attempt=attempt,
+                                error=str(e)[:160])
+            log(stage, case=case, error=str(e)[:200])
+            return None
+        outcome, detail = "ok", {}
+        if isinstance(out, dict) and out.get("outcome") \
+                in TERMINAL_OUTCOMES:
+            outcome = out["outcome"]
+            detail = {k: v for k, v in out.items() if k != "outcome"}
+        self.last_status = outcome
+        self.journal.record(stage, case, outcome, attempt=attempt,
+                            **detail)
+        return out
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     g_bench = 512
     quick = False
+    resume = False
+    stages = list(STAGES)
+    journal_path = None
     i = 0
     while i < len(argv):
         if argv[i] == "-g":
             g_bench = int(argv[i + 1]); i += 2
         elif argv[i] == "--quick":
             quick = True; i += 1
+        elif argv[i] == "--resume":
+            resume = True; i += 1
+        elif argv[i] == "--fresh":
+            resume = False
+            try:
+                os.remove(SessionJournal().path)
+            except OSError:
+                pass
+            i += 1
+        elif argv[i] == "--stages":
+            stages = [s.strip() for s in argv[i + 1].split(",")
+                      if s.strip()]
+            i += 2
         else:
             print(__doc__)
             return 2
@@ -103,79 +260,91 @@ def main(argv=None) -> int:
             "(YT_TPU_SESSION_FORCE=1 dry-runs the logic in interpret "
             "mode)")
         return 1
+    should_bank = (plat == "tpu"
+                   or os.environ.get("YT_SESSION_BANK") == "1")
 
-    def record(line, roofline=None):
-        """Persist one measured TPU row twice: bench.py's
-        TPU_RESULTS.jsonl (the ``last_tpu_measured`` contract fallback)
-        and the unified perf ledger (source ``tpu_session``) with
-        provenance + a sentinel verdict — relay windows are short, so
-        every row is banked the moment it exists."""
-        try:
-            from bench import _record_tpu_result
-            _record_tpu_result(line)
-        except Exception:  # noqa: BLE001
-            pass
-        try:
-            from yask_tpu.perflab import capture_provenance
-            from yask_tpu.perflab.sentinel import guard_and_append
-            prov = capture_provenance(
-                platform=plat,
-                device_kind=(getattr(env.get_devices()[0], "device_kind",
-                                     "") if env.get_devices() else ""))
-            extra = {k: v for k, v in line.items()
-                     if k not in ("metric", "value", "unit", "platform")}
-            guard_and_append(line["metric"], line["value"], line["unit"],
-                             plat, "tpu_session", prov,
-                             roofline=roofline, extra=extra or None)
-        except Exception as e:  # noqa: BLE001
-            log("ledger", error=str(e)[:160])
+    journal = SessionJournal(journal_path)
+    runner = SessionRunner(journal, resume, Breaker(threshold=3))
+    journal.record("session", "", "started", quick=quick,
+                   resume=resume, g=g_bench, stages=stages)
+
+    def record(line, roofline=None, sanity=None):
+        return bank_row(plat, env, line, roofline=roofline,
+                        sanity=sanity)
 
     # 1) smoke
-    ctx = build(fac, env, "iso3dfd", "jit", 128, 2)
-    ctx.run_solution(0, 4)
-    log("smoke", ok=True)
+    def smoke():
+        ctx = build(fac, env, "iso3dfd", "jit", 128, 2)
+        ctx.run_solution(0, 4)
+        log("smoke", ok=True)
 
     def run_matrix():
         # on-device pallas validation matrix
         failures = []
-        cases = MATRIX[:4] if quick else MATRIX
-        for name, radius in cases:
-            try:
+        cases = matrix_cases()
+        if quick and not os.environ.get("YT_SESSION_MATRIX"):
+            cases = cases[:4]
+
+        def one_case(name, radius):
+            def body():
                 ref = build(fac, env, name, "jit", 32, radius)
                 ref.run_solution(0, 3)
+                # oracle-sanity: an all-zero reference makes every
+                # comparison vacuous (zero stays zero under the linear
+                # homogeneous stencils) — the round-3 all-zero matrix
+                # "matched" exactly this way
+                overdict = check_output(
+                    maybe_corrupt("session.validate.oracle",
+                                  interior_slice(ref)))
+                case_bad = 0
+                anom = list(overdict["anomalies"])
                 for wf in (1, 2):
                     p = build(fac, env, name, "pallas", 32, radius,
                               wf=wf)
                     p.run_solution(0, 3)
+                    verdict = check_output(
+                        maybe_corrupt("session.validate.result",
+                                      interior_slice(p)))
                     bad = p.compare_data(ref, epsilon=1e-3,
                                          abs_epsilon=1e-4)
                     log("validate", stencil=name, K=wf,
-                        mismatches=int(bad))
+                        mismatches=int(bad),
+                        **({"anomalies": verdict["anomalies"]}
+                           if not verdict["ok"] else {}))
+                    anom += verdict["anomalies"]
                     if bad:
+                        case_bad += int(bad)
                         failures.append((name, wf, int(bad)))
-            except Exception as e:
-                log("validate", stencil=name, error=str(e)[:200])
-                failures.append((name, "error", str(e)[:80]))
+                if anom:
+                    failures.append((name, "anomaly",
+                                     ",".join(sorted(set(anom)))))
+                    return {"outcome": "anomaly",
+                            "anomalies": sorted(set(anom))}
+                return {"mismatches": case_bad}
+            return body
+
+        radii = dict(cases)
+        for name in runner.pending("validate", [n for n, _ in cases]):
+            out = runner.run_case("validate", name,
+                                  one_case(name, radii[name]))
+            if out is None and runner.last_status == "fault":
+                failures.append((name, "fault", ""))
         if failures:
             log("validate", summary="FAILURES", detail=failures)
         else:
             log("validate", summary="all pallas cases match jit on "
                 "device")
 
-    # 2) validation matrix ordering: on a --quick (first-window)
-    #    session the PERF stages run first — round 3 lost its hardware
-    #    numbers because the relay dropped while validation compiles
-    #    were still grinding; the A/B cross-checks below give internal
-    #    consistency and the matrix still runs afterwards if the window
-    #    holds.  Full sessions validate first (VERDICT r4 item 4).
-    if not quick:
-        run_matrix()
-
     def chunk_ab_stages() -> None:
         """Stage 3 (chunk A/Bs), setup included.  Crash-isolated from
         stages 4-5: the tune/bench build their own context, so a
         failure planning the flagship chunk must not cost the
         session's headline hardware number (round-3 failure mode)."""
+        ab_cases = ["pipeline_ab", "skew_ab.K2", "skew_ab.K4",
+                    "vmem_ladder", "esk_ab", "bf16_ab"]
+        if not runner.pending("chunk_abs", ab_cases):
+            log("chunk_abs", skipped="all cases journaled complete")
+            return
         # 3) pipeline + skew A/Bs (timing on real DMA engines).  Each stage
         #    is isolated: a Mosaic failure in one A/B must not cost the rest
         #    of the session (the relay window may be short).
@@ -216,14 +385,18 @@ def main(argv=None) -> int:
         interp = plat != "tpu"   # only under YT_TPU_SESSION_FORCE
         from yask_tpu.ops.pallas_stencil import default_vmem_budget
         budget = default_vmem_budget(plat)
+        case_anomalies = []   # verdicts since the current case began
 
         def time_chunk(tag, prog_=None, state_=None, metric=None,
                        npts=None, **kw):
             """Time one chunk variant; returns its one-chunk output state
-            (or None on failure) so A/B stages can cross-validate.  The
-            default (prog, state) pair is the fp32 flagship; the bf16 stage
-            passes its own so the timing/recording protocol stays single-
-            definition."""
+            (or None on failure/anomaly) so A/B stages can cross-validate.
+            The default (prog, state) pair is the fp32 flagship; the bf16
+            stage passes its own so the timing/recording protocol stays
+            single-definition.  Outputs pass the sanity guards: an
+            all-zero/NaN chunk result banks a QUARANTINED row and is
+            withheld from the bit-equality cross-checks (two corrupt arms
+            matching proves nothing)."""
             prog_ = prog_ or prog
             state_ = state_ if state_ is not None else state
             vb = kw.pop("vmem_budget", budget)
@@ -242,15 +415,23 @@ def main(argv=None) -> int:
                 dt = (time.perf_counter() - t0) / 5
                 k = kw.get("fuse_steps", 1)
                 gpts = round((npts or gi ** 3) * k / dt / 1e9, 2)
+                st1 = maybe_corrupt("session.chunk_result", st1)
+                sanity = check_output(st1)
                 log(tag, **{k2: v for k2, v in kw.items()},
                     tile_mib=round(tb / 2**20, 2),
-                    secs_per_chunk=round(dt, 5), gpts=gpts)
-                if plat == "tpu":
+                    secs_per_chunk=round(dt, 5), gpts=gpts,
+                    **({"anomalies": sanity["anomalies"]}
+                       if not sanity["ok"] else {}))
+                if should_bank:
                     record({
                         "metric": metric or (f"iso3dfd r=8 {gi}^3 fp32 tpu "
                                              f"pallas chunk ({tag} {kw})"),
                         "value": gpts, "unit": "GPts/s", "platform": plat,
-                        "vs_baseline": round(gpts / 500.0, 4)})
+                        "vs_baseline": round(gpts / 500.0, 4)},
+                        sanity=sanity)
+                if not sanity["ok"]:
+                    case_anomalies.extend(sanity["anomalies"])
+                    return None
                 return st1
             except Exception as e:  # noqa: BLE001
                 log(tag, error=str(e)[:300], **kw)
@@ -263,52 +444,72 @@ def main(argv=None) -> int:
                     m = max(m, float(jax.numpy.max(jax.numpy.abs(x - y))))
             return m
 
-        unpiped = time_chunk("pipeline_ab", fuse_steps=2,
-                             pipeline_dmas=False, skew=False)
-        piped = time_chunk("pipeline_ab", fuse_steps=2, pipeline_dmas=True,
-                           skew=False)
-        if unpiped is not None and piped is not None:
-            # bit-equality promised by the protocol: double-buffering must
-            # not change values (the aliasing hazard CLAUDE.md documents)
-            log("pipeline_ab", fuse_steps=2,
-                max_abs_diff=float(max_abs_diff(unpiped, piped)))
-        # skew A/B: uniform shrink vs streaming skewed wavefront, growing
-        # K; the two tilings must agree numerically on real Mosaic (first
-        # hardware execution of the carry machinery)
-        for k in (2, 4):
-            uni = time_chunk("skew_ab", fuse_steps=k, skew=False)
-            skw = time_chunk("skew_ab", fuse_steps=k, skew=True)
-            if uni is not None and skw is not None:
-                log("skew_ab", fuse_steps=k,
-                    max_abs_diff=float(max_abs_diff(uni, skw)))
-            # 1-D vs 2-D: force BOTH lead dims (the multi-dim carry's
-            # first hardware execution) and bit-compare against the
-            # 1-D arm — the second dim's row carry + diagonal corner
-            # propagation must agree exactly on real Mosaic
-            sk2 = time_chunk("skew2d_ab", fuse_steps=k,
-                             metric=(f"iso3dfd r=8 {gi}^3 fp32 tpu "
-                                     f"pallas chunk (skew2d K{k})"),
-                             skew=["x", "y"])
-            if skw is not None and sk2 is not None:
-                log("skew2d_ab", fuse_steps=k,
-                    max_abs_diff=float(max_abs_diff(skw, sk2)))
+        def case_outcome():
+            """Terminal-outcome dict for run_case from the verdicts the
+            case's time_chunk calls accumulated."""
+            if case_anomalies:
+                out = {"outcome": "anomaly",
+                       "anomalies": sorted(set(case_anomalies))}
+                case_anomalies.clear()
+                return out
+            return {}
 
-        # 3a3) vmem-budget ladder, measured directly: the joint tuner's
-        #      outer axis (64 MiB pins 8×32 blocks at the 512^3
-        #      flagship; 96 MiB admits 16×32 — the r5 open item).  Each
-        #      rung is its own ledger row so the sweep is comparable
-        #      across sessions.
-        for mb in (64, 96, 120):
-            time_chunk("vmem_ladder", fuse_steps=2,
-                       metric=(f"iso3dfd r=8 {gi}^3 fp32 tpu pallas "
-                               f"chunk (vmem {mb} MiB)"),
-                       vmem_budget=mb * 2 ** 20)
+        def pipeline_case():
+            unpiped = time_chunk("pipeline_ab", fuse_steps=2,
+                                 pipeline_dmas=False, skew=False)
+            piped = time_chunk("pipeline_ab", fuse_steps=2,
+                               pipeline_dmas=True, skew=False)
+            if unpiped is not None and piped is not None:
+                # bit-equality promised by the protocol: double-buffering
+                # must not change values (the aliasing hazard CLAUDE.md
+                # documents)
+                log("pipeline_ab", fuse_steps=2,
+                    max_abs_diff=float(max_abs_diff(unpiped, piped)))
+            return case_outcome()
 
-        # 3a2) misaligned-radius skew (E_sk window widening, r % sublane
-        #      != 0): the sublane-rounded write windows + widened regions
-        #      have only ever run in interpret mode — force skew on a
-        #      cube r=1 K=4 chunk and bit-compare against uniform.
-        try:
+        def skew_case(k):
+            # skew A/B: uniform shrink vs streaming skewed wavefront,
+            # growing K; the two tilings must agree numerically on real
+            # Mosaic (first hardware execution of the carry machinery)
+            def body():
+                uni = time_chunk("skew_ab", fuse_steps=k, skew=False)
+                skw = time_chunk("skew_ab", fuse_steps=k, skew=True)
+                if uni is not None and skw is not None:
+                    log("skew_ab", fuse_steps=k,
+                        max_abs_diff=float(max_abs_diff(uni, skw)))
+                # 1-D vs 2-D: force BOTH lead dims (the multi-dim carry's
+                # first hardware execution) and bit-compare against the
+                # 1-D arm — the second dim's row carry + diagonal corner
+                # propagation must agree exactly on real Mosaic
+                sk2 = time_chunk("skew2d_ab", fuse_steps=k,
+                                 metric=(f"iso3dfd r=8 {gi}^3 fp32 tpu "
+                                         f"pallas chunk (skew2d K{k})"),
+                                 skew=["x", "y"])
+                if skw is not None and sk2 is not None:
+                    log("skew2d_ab", fuse_steps=k,
+                        max_abs_diff=float(max_abs_diff(skw, sk2)))
+                return case_outcome()
+            return body
+
+        def vmem_ladder_case():
+            # 3a3) vmem-budget ladder, measured directly: the joint
+            #      tuner's outer axis (64 MiB pins 8×32 blocks at the
+            #      512^3 flagship; 96 MiB admits 16×32 — the r5 open
+            #      item).  Each rung is its own ledger row so the sweep
+            #      is comparable across sessions.
+            for mb in (64, 96, 120):
+                time_chunk("vmem_ladder", fuse_steps=2,
+                           metric=(f"iso3dfd r=8 {gi}^3 fp32 tpu pallas "
+                                   f"chunk (vmem {mb} MiB)"),
+                           vmem_budget=mb * 2 ** 20)
+            return case_outcome()
+
+        def esk_case():
+            # 3a2) misaligned-radius skew (E_sk window widening,
+            #      r % sublane != 0): the sublane-rounded write windows +
+            #      widened regions have only ever run in interpret mode —
+            #      force skew on a cube r=1 K=4 chunk and bit-compare
+            #      against uniform.
             gq = min(gi, 128)
             progc = create_solution("cube", radius=1).get_soln().compile() \
                 .plan(IdxTuple(x=gq, y=gq, z=gq),
@@ -325,17 +526,16 @@ def main(argv=None) -> int:
             if uni_c is not None and skw_c is not None:
                 log("esk_ab", fuse_steps=4,
                     max_abs_diff=float(max_abs_diff(uni_c, skw_c)))
-        except Exception as e:  # noqa: BLE001
-            log("esk_ab", error=str(e)[:300])
+            return case_outcome()
 
-        # 3b) bf16 A/B: the half-traffic roofline lever.  The CPU proxy
-        #     inverts (bf16 is software-emulated off-TPU) so only this
-        #     hardware row can confirm the >=1.5x target; sublane-16
-        #     geometry is exercised by the same chunk builder, and the
-        #     timing/recording protocol is time_chunk's single definition.
-        try:
-            from yask_tpu.compiler.solution_base import create_solution as _cs
-            sb16 = _cs("iso3dfd", radius=8)
+        def bf16_case():
+            # 3b) bf16 A/B: the half-traffic roofline lever.  The CPU
+            #     proxy inverts (bf16 is software-emulated off-TPU) so
+            #     only this hardware row can confirm the >=1.5x target;
+            #     sublane-16 geometry is exercised by the same chunk
+            #     builder, and the timing/recording protocol is
+            #     time_chunk's single definition.
+            sb16 = create_solution("iso3dfd", radius=8)
             sb16.get_soln().set_element_bytes(2)
             prog16 = sb16.get_soln().compile().plan(
                 IdxTuple(x=gi, y=gi, z=gi),
@@ -344,12 +544,20 @@ def main(argv=None) -> int:
             time_chunk("bf16_ab", prog_=prog16, state_=state16,
                        metric=f"iso3dfd r=8 {gi}^3 bf16 tpu pallas chunk K2",
                        fuse_steps=2)
-        except Exception as e:  # noqa: BLE001
-            log("bf16_ab", error=str(e)[:300])
+            return case_outcome()
 
-    def tune_bench_stages() -> int:
+        runner.run_case("chunk_abs", "pipeline_ab", pipeline_case)
+        for k in (2, 4):
+            runner.run_case("chunk_abs", f"skew_ab.K{k}", skew_case(k))
+        runner.run_case("chunk_abs", "vmem_ladder", vmem_ladder_case)
+        runner.run_case("chunk_abs", "esk_ab", esk_case)
+        runner.run_case("chunk_abs", "bf16_ab", bf16_case)
+
+    def tune_bench_stages():
         """Stages 4-5 (joint tune + tuned bench): independent context,
-        crash-isolated from the chunk A/Bs."""
+        crash-isolated from the chunk A/Bs.  One journaled unit — a
+        resumed bench without its tune would measure the untuned
+        config."""
         # 4) joint auto-tune at the bench size.  tune_max_wf_steps stays
         #    small: pads are planned for radius × the cap, so 16 would
         #    inflate every state array (784^3 for 512^3 at r=8) and make
@@ -370,66 +578,94 @@ def main(argv=None) -> int:
             log("tune", error=str(e)[:300])
 
         # 5) tuned bench
-        try:
-            steps = 4 if quick else 20
-            ctx.run_solution(0, steps - 1)   # warm
-            ctx.clear_stats()
-            ctx.run_solution(steps, 2 * steps - 1)
-            st = ctx.get_stats()
-            rate = st.get_pts_per_sec() / 1e9
-            # roofline fraction via the shared perflab model (the
-            # MFU-style number the performance doc's table wants per
-            # VERDICT r4 item 1) — one definition across the harness,
-            # bench, suite, and this session
-            from yask_tpu.perflab.roofline import ctx_roofline
-            roof = ctx_roofline(ctx, env, rate)
-            line = dict(
-                metric=f"iso3dfd r=8 {g_bench}^3 fp32 tpu pallas-tuned",
-                value=round(rate, 3), unit="GPts/s", platform=plat,
-                hbm_bytes_pp=roof["hbm_bytes_pp"],
-                roofline_frac=roof["roofline_frac"] or 0.0,
-                vs_baseline=round(rate / 500.0, 4))
-            log("bench", **line)
-            if plat == "tpu":
-                record(line, roofline=roof)
-        except Exception as e:  # noqa: BLE001
-            log("bench", error=str(e)[:300])
-            return 1
-        return 0
-
+        steps = 4 if quick else 20
+        ctx.run_solution(0, steps - 1)   # warm
+        ctx.clear_stats()
+        ctx.run_solution(steps, 2 * steps - 1)
+        st = ctx.get_stats()
+        rate = st.get_pts_per_sec() / 1e9
+        sanity = check_output(
+            maybe_corrupt("session.bench_result", interior_slice(ctx)))
+        # roofline fraction via the shared perflab model (the
+        # MFU-style number the performance doc's table wants per
+        # VERDICT r4 item 1) — one definition across the harness,
+        # bench, suite, and this session
+        from yask_tpu.perflab.roofline import ctx_roofline
+        roof = ctx_roofline(ctx, env, rate)
+        line = dict(
+            metric=f"iso3dfd r=8 {g_bench}^3 fp32 tpu pallas-tuned",
+            value=round(rate, 3), unit="GPts/s", platform=plat,
+            hbm_bytes_pp=roof["hbm_bytes_pp"],
+            roofline_frac=roof["roofline_frac"] or 0.0,
+            vs_baseline=round(rate / 500.0, 4))
+        log("bench", **line,
+            **({"anomalies": sanity["anomalies"]}
+               if not sanity["ok"] else {}))
+        if should_bank:
+            record(line, roofline=roof, sanity=sanity)
+        if not sanity["ok"]:
+            return {"outcome": "anomaly",
+                    "anomalies": sanity["anomalies"]}
+        return {}
 
     rc = 0
     try:
-        chunk_ab_stages()
-    except Exception as e:  # noqa: BLE001
-        log("chunk_abs", error=str(e)[:300])
-        rc = 1
-    try:
-        rc = tune_bench_stages() or rc
-    except Exception as e:  # noqa: BLE001
-        log("tune", error=str(e)[:300])
-        rc = 1
+        if "smoke" in stages:
+            runner.run_case("smoke", "", smoke)
 
-    # 5b) quick sessions validate AFTER the perf stages are banked
-    if quick:
-        run_matrix()
+        # 2) validation matrix ordering: on a --quick (first-window)
+        #    session the PERF stages run first — round 3 lost its
+        #    hardware numbers because the relay dropped while
+        #    validation compiles were still grinding; the A/B
+        #    cross-checks below give internal consistency and the
+        #    matrix still runs afterwards if the window holds.  Full
+        #    sessions validate first (VERDICT r4 item 4).
+        if not quick and "validate" in stages:
+            run_matrix()
 
-    # 6) Mosaic compile-time pathology check (LAST: mid-r3 saw ssg-K2 /
-    #    swe2d compiles >15 min; a hang here must not cost the session).
-    #    A/B the default tile-planner vinstr cap against a tight one so
-    #    the r5 `max_vinstr` knob is validated on real Mosaic.
-    for name, radius in (("ssg", 2), ("swe2d", None)):
-        for cap in (300_000, 64_000):
+        if "chunk_abs" in stages:
             try:
-                t0 = time.perf_counter()
-                c = build(fac, env, name, "pallas", 32, radius, wf=2)
-                c.get_settings().max_tile_vinstr = cap
-                c.run_solution(0, 1)
-                log("compile_time", stencil=name, max_vinstr=cap,
-                    secs=round(time.perf_counter() - t0, 1))
+                chunk_ab_stages()
+            except Fault:
+                raise
             except Exception as e:  # noqa: BLE001
-                log("compile_time", stencil=name, max_vinstr=cap,
-                    error=str(e)[:200])
+                log("chunk_abs", error=str(e)[:300])
+                rc = 1
+        if "tune_bench" in stages:
+            runner.run_case("tune_bench", "", tune_bench_stages)
+            if runner.last_status == "fault":
+                rc = 1
+
+        # 5b) quick sessions validate AFTER the perf stages are banked
+        if quick and "validate" in stages:
+            run_matrix()
+
+        # 6) Mosaic compile-time pathology check (LAST: mid-r3 saw
+        #    ssg-K2 / swe2d compiles >15 min; a hang here must not cost
+        #    the session).  A/B the default tile-planner vinstr cap
+        #    against a tight one so the r5 `max_vinstr` knob is
+        #    validated on real Mosaic.
+        if "compile_time" in stages:
+            def ct_case(name, radius, cap):
+                def body():
+                    t0 = time.perf_counter()
+                    c = build(fac, env, name, "pallas", 32, radius, wf=2)
+                    c.get_settings().max_tile_vinstr = cap
+                    c.run_solution(0, 1)
+                    log("compile_time", stencil=name, max_vinstr=cap,
+                        secs=round(time.perf_counter() - t0, 1))
+                return body
+            for name, radius in (("ssg", 2), ("swe2d", None)):
+                for cap in (300_000, 64_000):
+                    runner.run_case("compile_time", f"{name}.{cap}",
+                                    ct_case(name, radius, cap))
+    except Fault as f:
+        # breaker tripped inside run_case: the session is over — the
+        # journal already holds the abort marker and every banked case
+        log("session", aborted=True, fault=f.kind, error=str(f)[:200])
+        return 1
+
+    journal.record("session", "", "ok", rc=rc)
     return rc
 
 

@@ -1,50 +1,13 @@
 #!/bin/bash
-# Probe the axon TPU relay every ~3 min; run the session protocol on
-# EVERY window it answers (the relay windows have been short and rare —
-# CLAUDE.md "Environment gotchas").  First window runs --quick to bank
-# a number fast; later windows run the full validation matrix.  Each
-# session's artifacts are committed IMMEDIATELY (round 3 lost its
-# hardware numbers by waiting for round end).
+# Thin wrapper over yask_tpu.resilience.watch (the testable port of the
+# old inline loop): probe the axon TPU relay every ~3 min; run the
+# session protocol on EVERY window it answers (the relay windows have
+# been short and rare — CLAUDE.md "Environment gotchas").  First window
+# runs --quick to bank a number fast; later windows run the full
+# validation matrix; windows after a drop resume from the session
+# journal.  Each session's artifacts are committed IMMEDIATELY (round 3
+# lost its hardware numbers by waiting for round end).
 cd "$(dirname "$0")/.." || exit 1
 LOG=${1:-/tmp/tpu_session_auto.log}
 mkdir -p tools/logs
-N=0
-while true; do
-    if timeout 100 python - <<'EOF' >/dev/null 2>&1
-import subprocess, sys
-# require the axon/TPU backend, not a CPU fallback — otherwise the
-# session would be burned on CPU (bench.py _probe_platform does the
-# same check)
-r = subprocess.run(
-    [sys.executable, "-c",
-     "import jax; import sys; sys.exit(0 if jax.default_backend() in "
-     "('axon', 'tpu') else 3)"],
-    capture_output=True, timeout=90)
-sys.exit(r.returncode)
-EOF
-    then
-        N=$((N+1))
-        ARGS="-g 512 --quick"
-        [ "$N" -gt 1 ] && ARGS="-g 512"
-        SLOG="tools/logs/tpu_session_$(date -u +%m%d_%H%M%S).log"
-        echo "$(date -u +%H:%M:%S) relay UP - session $N ($ARGS)" >> "$LOG"
-        timeout 3000 python tools/tpu_session.py $ARGS > "$SLOG" 2>&1
-        echo "$(date -u +%H:%M:%S) session $N exit $?" >> "$LOG"
-        # Commit hardware artifacts the moment they exist.  Only the
-        # session-owned paths are staged so an in-progress working tree
-        # is never swept up; each pathspec is guarded (a missing
-        # TPU_RESULTS.jsonl — relay dropped before the first bench line
-        # — must not abort staging the session log); a transient
-        # index.lock just defers the commit to the next window.
-        PATHS="tools/logs"
-        [ -f TPU_RESULTS.jsonl ] && PATHS="$PATHS TPU_RESULTS.jsonl"
-        [ -f BENCH_suite_latest.json ] && PATHS="$PATHS BENCH_suite_latest.json"
-        git add -f $PATHS 2>/dev/null
-        git commit -m "TPU session $N artifacts (auto-committed by tpu_watch)" \
-            --only $PATHS >/dev/null 2>&1
-        sleep 60
-    else
-        echo "$(date -u +%H:%M:%S) relay down" >> "$LOG"
-        sleep 170
-    fi
-done
+exec python -m yask_tpu.resilience.watch -g 512 >> "$LOG" 2>&1

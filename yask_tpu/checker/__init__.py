@@ -128,8 +128,12 @@ def preflight(ctx, out=None, verbose: bool = False) -> bool:
     try:
         report = run_checks(ctx)
     except Exception as e:  # never let the gate kill the launch path
+        import traceback
         out.write(f"checker: internal failure ({type(e).__name__}: {e}); "
                   "skipping preflight\n")
+        # the full traceback, so a swallowed checker bug is debuggable
+        # from the session log instead of silently vanishing
+        out.write(traceback.format_exc())
         return True
     if report.errors or report.warnings or verbose:
         out.write(report.render(verbose=verbose))

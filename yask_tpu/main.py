@@ -241,7 +241,12 @@ def run_harness(argv: Optional[List[str]] = None, out=None) -> int:
                    # not a halo-cost change)
                    **({"halo_cal_spread":
                        round(st.get_halo_cal_spread(), 4)}
-                      if st.get_halo_cal_spread() > 0 else {})})
+                      if st.get_halo_cal_spread() > 0 else {}),
+                   # calibration kept an outlier beyond 3× the agreeing
+                   # pair's spread even after the one re-time: the split
+                   # is noise — marked, not banked as evidence
+                   **({"halo_cal_unstable": True}
+                      if st.get_halo_cal_unstable() else {})})
         out.write(f"ledger: recorded '{key}' "
                   f"(guard {row['guard'].get('status')})\n")
     return 0

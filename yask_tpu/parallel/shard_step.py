@@ -307,23 +307,42 @@ def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
                 break
         return (time.perf_counter() - t0) / calls
 
+    def _is_outlier(samples):
+        """Is the extreme sample an outlier?  The near distance (the
+        spread of the agreeing pair, floored at 2% of the median so two
+        near-identical samples don't declare everything an outlier)
+        sets the scale; an extreme beyond 3× it is rejected."""
+        lo, med, hi = samples[0], samples[len(samples) // 2], samples[-1]
+        if med <= 0:
+            return False
+        d_lo, d_hi = med - lo, hi - med
+        base = max(min(d_lo, d_hi), 0.02 * med)
+        return max(d_lo, d_hi) > 3.0 * base
+
     def timed_median(f, trials=3):
         """Median of ≥3 independent timed trials + their relative
-        spread ((max−min)/median).  The halo fraction is a (real −
-        twin) subtraction of two short samples, so a single outlier
-        trial (GC pause, co-tenant burst) lands directly in the
-        reported fraction; the median rejects it and the recorded
-        spread says how much the twin wandered — rows whose spread
-        rivals the fraction itself are not evidence of anything."""
+        spread ((max−min)/median) + an instability flag.  The halo
+        fraction is a (real − twin) subtraction of two short samples,
+        so a single outlier trial (GC pause, co-tenant burst) lands
+        directly in the reported fraction; the median rejects it, and
+        an extreme beyond 3× the agreeing pair's spread triggers ONE
+        full re-time — if the fresh trials are just as wild the
+        calibration is marked unstable (``halo_cal_unstable`` on the
+        ledger row) instead of banking a noisy split as evidence."""
         samples = sorted(timed(f) for _ in range(trials))
+        unstable = False
+        if _is_outlier(samples):
+            samples = sorted(timed(f) for _ in range(trials))
+            unstable = _is_outlier(samples)
         med = samples[len(samples) // 2]
         spread = (samples[-1] - samples[0]) / med if med > 0 else 0.0
-        return med, spread
+        return med, spread, unstable
 
-    t_no, sp_no = timed_median(fn_no)
-    t_ex, sp_ex = timed_median(fn)
+    t_no, sp_no, un_no = timed_median(fn_no)
+    t_ex, sp_ex, un_ex = timed_median(fn)
     ctx._halo_frac[key] = max(0.0, 1.0 - t_no / t_ex) if t_ex > 0 else 0.0
     ctx._halo_cal_spread[key] = max(sp_no, sp_ex)
+    ctx._halo_cal_unstable[key] = bool(un_no or un_ex)
     if fn_xonly is not None:
         ctx._halo_xround[key] = timed(fn_xonly)
     if fn_pack is not None:
@@ -618,6 +637,7 @@ def run_shard_map(ctx, start: int, n: int) -> None:
         ctx._halo_xround_last = ctx._halo_xround.get(key, 0.0)
         ctx._halo_xpack_last = ctx._halo_xpack.get(key, 0.0)
         ctx._halo_cal_spread_last = ctx._halo_cal_spread.get(key, 0.0)
+        ctx._halo_cal_unstable_last = ctx._halo_cal_unstable.get(key, False)
         cal_secs = time.perf_counter() - t0cal
 
     t0c2 = time.perf_counter()
@@ -958,6 +978,7 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
         ctx._halo_xround_last = ctx._halo_xround.get(key, 0.0)
         ctx._halo_xpack_last = ctx._halo_xpack.get(key, 0.0)
         ctx._halo_cal_spread_last = ctx._halo_cal_spread.get(key, 0.0)
+        ctx._halo_cal_unstable_last = ctx._halo_cal_unstable.get(key, False)
 
     ctx._resident = None   # interior buffers are donated next; any
     #                          failure before this point kept them valid

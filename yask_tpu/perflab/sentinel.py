@@ -115,10 +115,13 @@ DEFAULT_RULES: List[GuardRule] = [
 
 def is_clean(row: Dict) -> bool:
     """Usable as regression baseline: the row's own guard did not say
-    regression/breach, and the machine was not overloaded when it was
-    measured."""
+    regression/breach, the result passed the sanity guards (quarantined
+    rows carry anomalous — e.g. all-zero — data whose wall-clock is
+    meaningless), and the machine was not overloaded when measured."""
+    if row.get("quarantined"):
+        return False
     st = row.get("guard", {}).get("status", "ok")
-    if st in ("regression", "breach"):
+    if st in ("regression", "breach", "anomaly"):
         return False
     prov = row.get("provenance", {})
     load = prov.get("loadavg") or []
@@ -198,14 +201,33 @@ def guard_and_append(key: str, value: float, unit: str, platform: str,
                      remeasure: Optional[Callable[[], float]] = None,
                      roofline: Optional[Dict] = None,
                      extra: Optional[Dict] = None,
-                     path: Optional[str] = None) -> Dict:
+                     path: Optional[str] = None,
+                     sanity: Optional[Dict] = None) -> Dict:
     """The one-call producer path: look up this key's history in the
     ledger, evaluate the guards (with optional re-measure), build the
     row with the verdict inside, append it, return it.
 
+    ``sanity`` is a result-sanity verdict from
+    :func:`yask_tpu.resilience.check_output`: a failed one quarantines
+    the row (``quarantined: true`` + structured ``anomaly`` field,
+    guard status ``anomaly``) instead of guarding it — no re-measure is
+    attempted (re-timing corrupt data proves nothing) and
+    :func:`is_clean` keeps it out of every trailing-median baseline.
+
     ``source="bisect"`` rows are excluded from the history: they replay
     HISTORICAL revisions (tools/perf_bisect.py) and must not shift the
     trailing median the current code is judged against."""
+    if sanity and not sanity.get("ok", True):
+        from yask_tpu.resilience import anomaly_fields
+        af = anomaly_fields(sanity)
+        guard = {"status": "anomaly",
+                 "anomalies": af["anomaly"]["anomalies"]}
+        row = _ledger.make_row(key, value, unit, platform, source,
+                               provenance, guard=guard,
+                               roofline=roofline, extra=extra)
+        row.update(af)
+        _ledger.append_row(row, path=path)
+        return row
     history = [r for r in
                _ledger.read_rows(path=path, key=key, platform=platform)
                if r.get("source") != "bisect"]

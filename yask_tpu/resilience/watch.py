@@ -1,0 +1,175 @@
+"""Relay watcher: window detection, session planning, journal upkeep.
+
+The testable port of ``tools/tpu_watch.sh`` (now a thin wrapper): probe
+the axon relay on an interval, and on every window it answers run the
+session protocol with arguments chosen from the journal —
+
+* the **first** productive window runs ``--quick`` (bank a perf number
+  before validation compiles can eat the window — the round-3 lesson);
+* later windows run the full protocol;
+* whenever the journal holds incomplete work from a dropped window the
+  session gets ``--resume`` so it completes only the missing cases;
+* between sessions the journal is compacted (append-only during a
+  session, one row per case after it).
+
+Artifacts are committed the moment a session ends, exactly as the
+shell version did.  Run: ``python -m yask_tpu.resilience.watch
+[--loop | --probe | --plan]``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from yask_tpu.resilience.faults import DeviceHang
+from yask_tpu.resilience.guard import python_cmd, run_deadlined
+from yask_tpu.resilience.journal import (TERMINAL_OUTCOMES,
+                                         SessionJournal, repo_root)
+
+__all__ = ["relay_up", "session_args", "run_session", "watch_loop"]
+
+#: the probe requires the axon/TPU backend, not a CPU fallback —
+#: otherwise a session would be burned on CPU (same check as
+#: bench._probe_platform).
+PROBE_CODE = ("import jax, sys; "
+              "sys.exit(0 if jax.default_backend() in ('axon', 'tpu') "
+              "else 3)")
+
+
+def relay_up(timeout: float = 90.0,
+             probe_cmd: Optional[List[str]] = None) -> bool:
+    """One relay probe in a killable subprocess: True only when the
+    default backend is the real TPU/axon one.  A hang (relay half-up)
+    counts as down."""
+    cmd = probe_cmd if probe_cmd is not None else python_cmd(PROBE_CODE)
+    try:
+        rc, _ = run_deadlined(cmd, timeout, site="watch.probe")
+    except DeviceHang:
+        return False
+    return rc == 0
+
+
+def session_args(journal: SessionJournal, g: int = 512) -> List[str]:
+    """Arguments for the next session, planned from the journal:
+    ``--quick`` until one session has completed (bank numbers fast on
+    the first window), ``--resume`` whenever journaled work is
+    incomplete (a dropped relay no longer forfeits banked cases)."""
+    args = ["-g", str(g)]
+    rows = journal.rows()
+    if not any(r["stage"] == "session" and r["outcome"] == "ok"
+               for r in rows):
+        # no session has ever completed: bank-numbers-first posture
+        args.append("--quick")
+    if rows and any(r["outcome"] not in TERMINAL_OUTCOMES
+                    for r in journal.last_outcomes().values()):
+        args.append("--resume")
+    return args
+
+
+def run_session(args: List[str], timeout: float = 3000.0,
+                log_dir: Optional[str] = None) -> int:
+    """One ``tools/tpu_session.py`` run under a hard deadline, stdout
+    tee'd to a timestamped log under ``tools/logs``."""
+    root = repo_root()
+    log_dir = log_dir or os.path.join(root, "tools", "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    log_path = os.path.join(
+        log_dir, time.strftime("tpu_session_%m%d_%H%M%S.log",
+                               time.gmtime()))
+    cmd = [sys.executable, os.path.join(root, "tools", "tpu_session.py")]
+    cmd += args
+    try:
+        rc, out = run_deadlined(cmd, timeout, site="watch.session",
+                                stderr=subprocess.STDOUT)
+    except DeviceHang as e:
+        rc, out = -9, e.partial_stdout
+    try:
+        with open(log_path, "w") as f:
+            f.write(out)
+    except OSError:
+        pass
+    return rc
+
+
+def commit_artifacts(root: Optional[str] = None) -> None:
+    """Commit hardware artifacts the moment they exist (round 3 lost
+    its numbers by waiting for round end).  Only session-owned paths
+    are staged; every failure here is non-fatal — a transient
+    index.lock just defers to the next window."""
+    root = root or repo_root()
+    paths = ["tools/logs"]
+    for p in ("TPU_RESULTS.jsonl", "BENCH_suite_latest.json",
+              "SESSION_JOURNAL.jsonl"):
+        if os.path.exists(os.path.join(root, p)):
+            paths.append(p)
+    try:
+        subprocess.run(["git", "add", "-f", *paths], cwd=root,
+                       stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL, timeout=60)
+        subprocess.run(
+            ["git", "commit", "-m",
+             "TPU session artifacts (auto-committed by watch)",
+             "--only", *paths],
+            cwd=root, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, timeout=60)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def watch_loop(g: int = 512, probe_secs: float = 170.0,
+               settle_secs: float = 60.0, max_windows: int = 0,
+               journal: Optional[SessionJournal] = None,
+               out=None) -> int:
+    """Probe forever (or for ``max_windows`` productive windows, for
+    tests); on each window plan args from the journal, run the session,
+    commit artifacts, compact the journal."""
+    out = out or sys.stderr
+    journal = journal or SessionJournal()
+    windows = 0
+    while True:
+        if relay_up():
+            windows += 1
+            args = session_args(journal, g=g)
+            out.write(f"watch: relay UP — session {windows} "
+                      f"({' '.join(args)})\n")
+            rc = run_session(args)
+            out.write(f"watch: session {windows} exit {rc}\n")
+            commit_artifacts()
+            journal.compact()
+            if max_windows and windows >= max_windows:
+                return 0
+            time.sleep(settle_secs)
+        else:
+            out.write("watch: relay down\n")
+            if max_windows and windows >= max_windows:
+                return 0
+            time.sleep(probe_secs)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    g = 512
+    if "-g" in argv:
+        i = argv.index("-g")
+        g = int(argv[i + 1])
+        del argv[i:i + 2]
+    if "--probe" in argv:
+        up = relay_up()
+        print("up" if up else "down")
+        return 0 if up else 3
+    if "--plan" in argv:
+        print(" ".join(session_args(SessionJournal(), g=g)))
+        return 0
+    if "--compact" in argv:
+        dropped = SessionJournal().compact()
+        print(f"journal compacted ({dropped} row(s) dropped)")
+        return 0
+    return watch_loop(g=g)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

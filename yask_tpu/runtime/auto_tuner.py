@@ -22,6 +22,9 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Tuple
 
+from yask_tpu.resilience import (Breaker, CompilerOOM, classify,
+                                 fault_point)
+
 
 class AutoTuner:
     #: chunk-length candidates for the K-only sweep (jit/sharded modes).
@@ -40,6 +43,15 @@ class AutoTuner:
     def __init__(self, ctx):
         self.ctx = ctx
         self.results: Dict[Tuple, float] = {}   # candidate → secs/step
+        # Outage breaker shared across every candidate of a walk: a dead
+        # relay makes EVERY compile fail, and three consecutive failures
+        # must stay loud (round-3 postmortem; hoisted to the shared
+        # yask_tpu.resilience.Breaker).
+        self._breaker = Breaker(threshold=3)
+
+    @property
+    def _consec_fails(self) -> int:
+        return self._breaker.consecutive
 
     def is_done(self) -> bool:
         return getattr(self.ctx, "_tuned", False)
@@ -130,6 +142,7 @@ class AutoTuner:
                 ctx._cur_step += k * dirn
         from yask_tpu.utils.exceptions import YaskException
         try:
+            fault_point("tuner.measure")
             compiled = make_compiled()
         except YaskException:
             # infeasible candidate (tile over the VMEM budget, fusion
@@ -145,35 +158,34 @@ class AutoTuner:
             # allocator spill slots", surfaced as an INTERNAL remote-
             # compile error).  Walking on is the reference tuner's
             # stance too: a failed apply just scores worst
-            # (auto_tuner.cpp eval loop).  But a dead relay makes EVERY
-            # compile fail with backend errors — three consecutive
-            # failures re-raise so an outage stays loud instead of
-            # ending the walk "successfully" with all-inf results.
+            # (auto_tuner.cpp eval loop).  Classification lives in
+            # yask_tpu.resilience: a CompilerOOM is a *genuinely
+            # infeasible candidate* and never counts toward the outage
+            # breaker (so the vmem ladder's ambitious rungs can strike
+            # out on dense kernels without ending the walk); every
+            # other classified fault (relay drop / hang / compile
+            # failure — a dead relay makes EVERY compile fail) feeds
+            # the breaker, and three consecutive failures re-raise so
+            # an outage stays loud instead of ending the walk
+            # "successfully" with all-inf results.
+            fault = classify(e, site="tuner.measure")
+            if fault is None:
+                raise
             msg = f"{type(e).__name__}: {e}"
-            if "RESOURCE_EXHAUSTED" in msg or "vmem" in msg.lower():
-                # A Mosaic VMEM OOM (register-spill slots over
-                # vmem_limit_bytes) is a *genuinely infeasible
-                # candidate*, not an outage symptom: it never counts
-                # toward the consecutive-failure breaker, so the vmem
-                # ladder's ambitious rungs can strike out on dense
-                # kernels without ending the walk.
+            if isinstance(fault, CompilerOOM):
                 self.ctx._env.trace_msg(
                     f"auto-tuner: candidate {key} exceeded VMEM "
                     f"({msg[:160]}); marking infeasible")
                 self.results[key] = float("inf")
                 return float("inf")
-            if ("Mosaic" in msg or "INTERNAL" in msg
-                    or "tpu_compile" in msg):
-                self._consec_fails = getattr(self, "_consec_fails", 0) + 1
-                if self._consec_fails >= 3:
-                    raise
-                self.ctx._env.trace_msg(
-                    f"auto-tuner: candidate {key} failed to compile "
-                    f"({msg[:160]}); marking infeasible")
-                self.results[key] = float("inf")
-                return float("inf")
-            raise
-        self._consec_fails = 0
+            if self._breaker.record(fault):
+                raise
+            self.ctx._env.trace_msg(
+                f"auto-tuner: candidate {key} failed "
+                f"[{fault.kind}] ({msg[:160]}); marking infeasible")
+            self.results[key] = float("inf")
+            return float("inf")
+        self._breaker.reset()
         # warmup call (not timed — excludes dispatch jitter)
         call(compiled)
         calls = 0

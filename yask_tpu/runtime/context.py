@@ -349,9 +349,11 @@ class StencilContext:
         self._halo_xround = {}       # key -> secs per bare exchange round
         self._halo_xpack = {}        # key -> secs pack-only (no collective)
         self._halo_cal_spread = {}   # key -> rel spread of the twin trials
+        self._halo_cal_unstable = {}  # key -> outliers survived re-time
         self._halo_xround_last = 0.0
         self._halo_xpack_last = 0.0
         self._halo_cal_spread_last = 0.0
+        self._halo_cal_unstable_last = False
         for h in self._hooks["after_prepare"]:
             h(self)
 
@@ -1045,6 +1047,7 @@ class StencilContext:
             halo_exchange_secs=self._halo_xround_last,
             halo_pack_secs=self._halo_xpack_last,
             halo_cal_spread=self._halo_cal_spread_last,
+            halo_cal_unstable=self._halo_cal_unstable_last,
             read_bytes_pp=rb_pp, write_bytes_pp=wb_pp,
             # aggregate peak: throughput is global (all chips), so the
             # roofline denominator must scale with the mesh size

@@ -17,6 +17,7 @@ class yk_stats:
                  halo_exchange_secs: float = 0.0,
                  halo_pack_secs: float = 0.0,
                  halo_cal_spread: float = 0.0,
+                 halo_cal_unstable: bool = False,
                  read_bytes_pp: float = 0.0, write_bytes_pp: float = 0.0,
                  hbm_peak: float = 0.0, tiling: dict | None = None):
         self._npts = npts
@@ -30,6 +31,7 @@ class yk_stats:
         self._halo_xround = halo_exchange_secs
         self._halo_xpack = halo_pack_secs
         self._halo_cal_spread = halo_cal_spread
+        self._halo_cal_unstable = halo_cal_unstable
         self._rb_pp = read_bytes_pp
         self._wb_pp = write_bytes_pp
         self._hbm_peak = hbm_peak
@@ -111,6 +113,16 @@ class yk_stats:
         can't masquerade as a halo-cost change."""
         return self._halo_cal_spread
 
+    def get_halo_cal_unstable(self) -> bool:
+        """True when the halo calibration stayed outlier-contaminated
+        even after its one full re-time (an extreme trial beyond 3× the
+        agreeing pair's spread, twice in a row).  The fraction is still
+        reported — the median is the best available estimate — but
+        consumers must treat the row as noise, not evidence: the ledger
+        marks it ``halo_cal_unstable`` and the sentinel's baseline
+        logic ignores such rows."""
+        return self._halo_cal_unstable
+
     def get_hbm_bytes_per_point(self) -> float:
         """Modeled HBM traffic (read+write) per point per step."""
         return self._rb_pp + self._wb_pp
@@ -138,7 +150,9 @@ class yk_stats:
                 f"halo-exchange-round (sec): {self._halo_xround:.6g}\n"
                 f"halo-pack (sec): {self._halo_xpack:.6g}\n"
                 f"halo-cal-spread (rel): {self._halo_cal_spread:.4g}\n"
-                f"halo-collective (sec): "
+                + ("halo-cal-unstable: true\n"
+                   if self._halo_cal_unstable else "")
+                + f"halo-collective (sec): "
                 f"{self.get_halo_collective_secs():.6g}\n"
                 f"hbm-bytes-per-point (read+write): "
                 f"{self.get_hbm_bytes_per_point():.6g}\n"
