@@ -24,7 +24,7 @@ def env():
 
 
 def _build(env, name, radius, mode, wf=1, blk=None, eb=4, ranks=(),
-           overlap=True):
+           overlap=True, ovx=None):
     from yask_tpu.runtime.init_utils import init_solution_vars
     from yask_tpu.compiler.solution_base import create_solution
     fac = yk_factory()
@@ -39,6 +39,8 @@ def _build(env, name, radius, mode, wf=1, blk=None, eb=4, ranks=(),
     s.mode = mode
     s.wf_steps = wf
     s.overlap_comms = overlap
+    if ovx is not None:
+        s.overlap_exchange = ovx
     for d, b in (blk or {}).items():
         ctx.set_block_size(d, b)
     for d, r in ranks:
@@ -52,7 +54,7 @@ _jit_ref_cache = {}
 
 
 def _check(env, name, radius, mode, wf=1, blk=None, eb=4, ranks=(),
-           overlap=True):
+           overlap=True, ovx=None):
     eps = (1e-3, 1e-4) if eb == 4 else (3e-2, 3e-2)
     key = (name, radius, eb)
     if key not in _jit_ref_cache:
@@ -66,7 +68,7 @@ def _check(env, name, radius, mode, wf=1, blk=None, eb=4, ranks=(),
                                     abs_epsilon=eps[1]) == 0
         _jit_ref_cache[key] = ref
     ctx = _build(env, name, radius, mode, wf=wf, blk=blk, eb=eb,
-                 ranks=ranks, overlap=overlap)
+                 ranks=ranks, overlap=overlap, ovx=ovx)
     ctx.run_solution(0, 1)
     assert ctx.compare_data(_jit_ref_cache[key], epsilon=eps[0],
                             abs_epsilon=eps[1]) == 0
@@ -122,3 +124,14 @@ def test_matrix_overlap_split(env, overlap, name, radius):
 @pytest.mark.parametrize("eb", [4, 2], ids=["fp32", "bf16"])
 def test_matrix_distributed_dtypes(env, eb):
     _check(env, "iso3dfd", 2, "shard_map", eb=eb, ranks=[("x", 4)])
+
+
+@pytest.mark.parametrize("ovx", ["on", "off", "auto"])
+@pytest.mark.parametrize("name,radius", [("iso3dfd", 2), ("cube", 1)])
+def test_matrix_overlap_exchange(env, ovx, name, radius):
+    # overlapped halo exchange (core/shell split of the fused K-group)
+    # as a matrix axis: x2 ranks on g=24 give lsize 12 ≥ 2·hK, so "on"
+    # genuinely splits (the forced arm errors rather than silently
+    # comparing serial to serial)
+    _check(env, name, radius, "shard_pallas", wf=2, ranks=[("x", 2)],
+           ovx=ovx)

@@ -1,0 +1,196 @@
+"""Overlapped halo exchange for shard_pallas (core/shell split of the
+fused K-group): the overlap arm must be BIT-identical to the serial
+schedule (``compare_data(epsilon=0)``) and agree with the jit oracle in
+every engaged configuration — K>1, 2-D meshes, skew-engaged, remainder
+groups — while the auto gate must reject rank domains < 2·hK with the
+serial fallback, and forcing ``on`` on an infeasible geometry must
+raise.  Also covers the resident slice-API fast path (open item riding
+this round): all-interior slice reads/writes must ride the
+device-resident ring without materializing the padded state.
+"""
+
+import numpy as np
+import pytest
+
+from yask_tpu import yk_factory
+from yask_tpu.utils.exceptions import YaskException
+
+
+@pytest.fixture(scope="module")
+def env():
+    e = yk_factory().new_env()
+    if e.get_num_ranks() < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    return e
+
+
+def _mk(env, mode, ovx="auto", wf=2, g=(32, 8, 16), radius=2,
+        ranks=(("x", 2),), spans=((0, 3),)):
+    from yask_tpu.runtime.init_utils import init_solution_vars
+    ctx = yk_factory().new_solution(env, stencil="iso3dfd", radius=radius)
+    gx, gy, gz = g
+    ctx.apply_command_line_options(f"-g_x {gx} -g_y {gy} -g_z {gz}")
+    s = ctx.get_settings()
+    s.mode = mode
+    if mode in ("pallas", "shard_pallas"):
+        s.wf_steps = wf
+        s.overlap_exchange = ovx
+        for d, n in ranks:
+            ctx.set_num_ranks(d, n)
+    ctx.prepare_solution()
+    init_solution_vars(ctx)
+    for a, b in spans:
+        ctx.run_solution(a, b)
+    return ctx
+
+
+def _tiling(ctx):
+    til = ctx.get_stats().get_tiling()
+    assert til is not None
+    return til
+
+
+_oracles = {}
+
+
+def _oracle(env, g, radius, spans=((0, 3),)):
+    key = (g, radius, spans)
+    if key not in _oracles:
+        _oracles[key] = _mk(env, "jit", g=g, radius=radius, spans=spans)
+    return _oracles[key]
+
+
+# ---- engaged configurations: bitwise on == off, both match jit ---------
+
+def test_engaged_k2_matches_serial_and_oracle(env):
+    # lsize_x = 16 ≥ 2·hK = 8 (r=2, K=2) → auto engages
+    on = _mk(env, "shard_pallas", "on")
+    off = _mk(env, "shard_pallas", "off")
+    til = _tiling(on)
+    assert til["overlap_exchange"] is True
+    assert "x" in til["overlap_core"]
+    assert _tiling(off)["overlap_exchange"] is False
+    assert on.compare_data(off, epsilon=0.0, abs_epsilon=0.0) == 0
+    assert on.compare_data(_oracle(env, (32, 8, 16), 2),
+                           epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+def test_auto_arm_engages_and_matches(env):
+    auto = _mk(env, "shard_pallas", "auto")
+    assert _tiling(auto)["overlap_exchange"] is True
+    off = _mk(env, "shard_pallas", "off")
+    assert auto.compare_data(off, epsilon=0.0, abs_epsilon=0.0) == 0
+
+
+def test_overlap_remainder_group(env):
+    # 5 steps under K=2 → two full groups + a 1-step remainder group:
+    # a single fused step has no core compute window, so the schedule
+    # runs it whole on post-exchange state (recorded reason) and the
+    # bit-equality with the serial arm must survive the mixed schedule
+    spans = ((0, 4),)
+    on = _mk(env, "shard_pallas", "on", spans=spans)
+    off = _mk(env, "shard_pallas", "off", spans=spans)
+    til = _tiling(on)
+    assert any(r.get("code") == "overlap_rem_unsplit"
+               for r in til["overlap_reasons"])
+    assert on.compare_data(off, epsilon=0.0, abs_epsilon=0.0) == 0
+    assert on.compare_data(_oracle(env, (32, 8, 16), 2, spans),
+                           epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+def test_overlap_split_remainder_group(env):
+    # 5 steps under K=3 → one full group + a 2-step remainder group
+    # that DOES re-derive the core/shell split (rem ≥ 2)
+    spans = ((0, 4),)
+    on = _mk(env, "shard_pallas", "on", wf=3, spans=spans)
+    off = _mk(env, "shard_pallas", "off", wf=3, spans=spans)
+    til = _tiling(on)
+    assert til["overlap_exchange"] is True
+    assert not any(r.get("code") == "overlap_rem_unsplit"
+                   for r in til["overlap_reasons"])
+    assert on.compare_data(off, epsilon=0.0, abs_epsilon=0.0) == 0
+
+
+def test_overlap_2d_mesh_sublane_alignment(env):
+    # y is the sublane dim: core bounds snap to 8-multiples, so the y
+    # split needs lsize_y = 24 (lo=8, hi=16); x keeps unit alignment
+    g, ranks = (32, 48, 16), (("x", 2), ("y", 2))
+    on = _mk(env, "shard_pallas", "on", g=g, ranks=ranks)
+    off = _mk(env, "shard_pallas", "off", g=g, ranks=ranks)
+    til = _tiling(on)
+    assert til["overlap_exchange"] is True
+    assert set(til["overlap_core"]) == {"x", "y"}
+    assert on.compare_data(off, epsilon=0.0, abs_epsilon=0.0) == 0
+    assert on.compare_data(_oracle(env, g, 2),
+                           epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+def test_overlap_skew_engaged(env):
+    # r=8 K=2 engages the skewed wavefront (stream radius % sublane
+    # tile == 0) AND the split: lsize_x = 36 ≥ 2·hK = 32 + alignment
+    g = (72, 48, 32)
+    on = _mk(env, "shard_pallas", "on", g=g, radius=8)
+    off = _mk(env, "shard_pallas", "off", g=g, radius=8)
+    til = _tiling(on)
+    assert til["skew"] is True
+    assert til["overlap_exchange"] is True
+    assert on.compare_data(off, epsilon=0.0, abs_epsilon=0.0) == 0
+    assert on.compare_data(_oracle(env, g, 8),
+                           epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+# ---- the auto gate: small rank domains must reject, not corrupt --------
+
+def test_auto_gate_rejects_small_domain(env):
+    # lsize_x = 6 < 2·hK = 8: auto must fall back to the serial
+    # schedule (and say why), and the answer must still be right
+    g, ranks = (24, 8, 16), (("x", 4),)
+    auto = _mk(env, "shard_pallas", "auto", g=g, ranks=ranks)
+    til = _tiling(auto)
+    assert til["overlap_exchange"] is False
+    assert any("overlap" in r.get("code", "")
+               for r in til["overlap_reasons"])
+    assert auto.compare_data(_oracle(env, g, 2),
+                             epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+def test_forced_on_infeasible_raises(env):
+    with pytest.raises(YaskException, match="overlap"):
+        _mk(env, "shard_pallas", "on", g=(24, 8, 16), ranks=(("x", 4),))
+
+
+def test_single_step_groups_never_split(env):
+    # K=1 groups are one fused step: nothing to hide an exchange
+    # under — auto stays serial (with a reason), forcing "on" raises
+    auto = _mk(env, "shard_pallas", "auto", wf=1)
+    til = _tiling(auto)
+    assert til["overlap_exchange"] is False
+    assert any("single-step" in r.get("cause", "")
+               for r in til["overlap_reasons"])
+    with pytest.raises(YaskException, match="overlap"):
+        _mk(env, "shard_pallas", "on", wf=1)
+
+
+# ---- resident slice fast path (device-resident shard state) ------------
+
+def test_resident_slice_fast_path(env):
+    ctx = _mk(env, "shard_pallas", "auto")
+    v = ctx.get_var("pressure")
+    assert ctx._resident is not None
+    # all-interior box: must ride the resident ring, no materialize
+    box = ([3, 4, 0, 2], [3, 27, 7, 13])
+    a_fast = v.get_elements_in_slice(*box)
+    assert ctx._resident is not None
+    # interior write stays resident too
+    v.set_elements_in_slice(a_fast * 2.0, *box)
+    assert ctx._resident is not None
+    b_fast = v.get_elements_in_slice(*box)
+    assert np.array_equal(b_fast, a_fast * 2.0)
+    v.set_elements_in_slice(a_fast, *box)
+    # pad-touching box: falls back to the strict materializing path
+    pad = v.get_elements_in_slice([3, -1, 0, 0], [3, 0, 0, 0])
+    assert ctx._resident is None
+    assert pad[0].item() == 0.0   # ghost pads are identically zero
+    # the strict path must agree with what the fast path returned
+    a_strict = v.get_elements_in_slice(*box)
+    assert np.array_equal(a_strict, a_fast)

@@ -15,7 +15,8 @@ a completed joint tune, and a tuned bench number (VERDICT r3 items
    sessions (round 3 lost its hardware numbers to a relay drop while
    validation compiles were still grinding);
 3. A/B: pipeline_dmas / skew / misaligned-E_sk / bf16 chunk variants
-   (bit-equality cross-checks + timing on real DMA engines);
+   (bit-equality cross-checks + timing on real DMA engines) plus the
+   shard_pallas overlapped-exchange arms when >1 device is attached;
 4. tune: joint (K, block) auto-tuner walk on iso3dfd at the bench size;
 5. report: a BENCH-style JSON line per stage (each perf row is
    persisted to TPU_RESULTS.jsonl the moment it is measured); then
@@ -341,7 +342,7 @@ def main(argv=None) -> int:
         failure planning the flagship chunk must not cost the
         session's headline hardware number (round-3 failure mode)."""
         ab_cases = ["pipeline_ab", "skew_ab.K2", "skew_ab.K4",
-                    "vmem_ladder", "esk_ab", "bf16_ab"]
+                    "vmem_ladder", "esk_ab", "bf16_ab", "overlap_ab"]
         if not runner.pending("chunk_abs", ab_cases):
             log("chunk_abs", skipped="all cases journaled complete")
             return
@@ -546,12 +547,98 @@ def main(argv=None) -> int:
                        fuse_steps=2)
             return case_outcome()
 
+        def overlap_ab_case():
+            # 3c) overlapped halo exchange A/B: first hardware execution
+            #     of the shard_pallas core/shell split.  The serial and
+            #     overlapped arms must be bit-identical (corrupt arms
+            #     are withheld from the comparison — two corrupt arms
+            #     matching proves nothing); the speedup row feeds the
+            #     TPU-scoped sp-overlap-speedup sentinel floor, and
+            #     each arm's measured overlap efficiency is banked so
+            #     hardware finally answers how much collective cost
+            #     the split hides.
+            ndev = env.get_num_ranks()
+            if ndev <= 1:
+                log("overlap_ab", skipped="single device")
+                return {"outcome": "skip", "reason": "single device"}
+            from yask_tpu.runtime.init_utils import init_solution_vars
+            from yask_tpu.utils.exceptions import YaskException
+            go = min(g_bench, 256)
+            steps = 8
+
+            def mk(ovx):
+                c = fac.new_solution(env, stencil="iso3dfd", radius=8)
+                c.apply_command_line_options(
+                    f"-g {go} -wf_steps 2 -mode shard_pallas "
+                    f"-measure_halo -overlap_x {ovx} -nr_x {ndev}")
+                c.prepare_solution()
+                init_solution_vars(c)
+                return c
+
+            def run_arm(ovx):
+                try:
+                    c = mk(ovx)
+                    c.run_solution(0, 3)       # warmup (compiles; a
+                    #   forced-on split that cannot engage raises HERE,
+                    #   at the first chunk build)
+                except YaskException as e:
+                    return None, None, str(e)[:200]
+                t0 = time.perf_counter()
+                c.run_solution(4, 4 + steps - 1)
+                dt = time.perf_counter() - t0
+                gpts = round(go ** 3 * steps / dt / 1e9, 3)
+                sanity = check_output(
+                    maybe_corrupt("session.overlap.result",
+                                  interior_slice(c)))
+                eff = round(c.get_stats().get_halo_overlap_eff(), 4)
+                log("overlap_ab", arm=ovx, gpts=gpts, overlap_eff=eff,
+                    **({"anomalies": sanity["anomalies"]}
+                       if not sanity["ok"] else {}))
+                if should_bank:
+                    record({"metric": (f"iso3dfd r=8 {go}^3 {plat} "
+                                       f"x{ndev} shard_pallas "
+                                       f"(overlap {ovx})"),
+                            "value": gpts, "unit": "GPts/s",
+                            "platform": plat, "overlap_eff": eff},
+                           sanity=sanity)
+                if not sanity["ok"]:
+                    case_anomalies.extend(sanity["anomalies"])
+                    return None, gpts, None
+                return c, gpts, None
+
+            c_off, g_off, err = run_arm("off")
+            if err:
+                log("overlap_ab", error=err)
+                return {"outcome": "skip", "reason": err}
+            c_on, g_on, err = run_arm("on")
+            if err:
+                # forced "on" raised: the geometry cannot split (e.g.
+                # rank domains < 2·hK at this device count) — a
+                # journaled skip, not a failure
+                log("overlap_ab", skipped=f"overlap infeasible: {err}")
+                return {"outcome": "skip", "reason": err}
+            if c_off is not None and c_on is not None:
+                bad = int(c_on.compare_data(c_off, epsilon=0.0,
+                                            abs_epsilon=0.0))
+                log("overlap_ab", mismatches=bad)
+                if should_bank and g_off and g_on:
+                    record({"metric": (f"iso3dfd r=8 {go}^3 {plat} "
+                                       f"x{ndev} sp-overlap-speedup"),
+                            "value": round(g_on / g_off, 4),
+                            "unit": "x", "platform": plat,
+                            "serial_gpts": g_off, "overlap_gpts": g_on,
+                            "mismatches": bad})
+                if bad:
+                    case_anomalies.append(f"overlap-mismatch:{bad}")
+            return case_outcome()
+
         runner.run_case("chunk_abs", "pipeline_ab", pipeline_case)
         for k in (2, 4):
             runner.run_case("chunk_abs", f"skew_ab.K{k}", skew_case(k))
         runner.run_case("chunk_abs", "vmem_ladder", vmem_ladder_case)
         runner.run_case("chunk_abs", "esk_ab", esk_case)
         runner.run_case("chunk_abs", "bf16_ab", bf16_case)
+        runner.run_case("chunk_abs", "overlap_ab", overlap_ab_case)
 
     def tune_bench_stages():
         """Stages 4-5 (joint tune + tuned bench): independent context,

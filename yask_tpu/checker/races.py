@@ -212,6 +212,52 @@ def check_distributed(report: CheckReport, ctx) -> None:
                 "consume", dim=d,
                 detail={"rank_domain": lsizes[d], "ghost": hK[d]})
 
+    # Overlapped-exchange decision: replay the EXACT runtime gate
+    # (shard_step.overlap_decision — one definition, so the checker and
+    # the executor can never drift) statically.  Engage/auto-off are
+    # informational; a forced "on" that the geometry cannot honor is
+    # the error class _prep_shard_pallas would raise at build time.
+    if mode == "shard_pallas":
+        from yask_tpu.parallel.shard_step import overlap_decision
+        setting = getattr(opts, "overlap_exchange", "auto")
+        try:
+            ov_ok, ov_core, ov_shells, ov_reasons = \
+                overlap_decision(ctx, K)
+        except Exception:
+            ov_ok, ov_reasons = False, None  # geometry reported above
+        if ov_ok:
+            report.add(
+                "OVERLAP-ENGAGED", "info",
+                f"overlapped halo exchange engages (overlap_x="
+                f"{setting}): core "
+                f"{ {d: list(v) for d, v in sorted(ov_core.items())} } "
+                "computes on pre-exchange state while the previous "
+                f"group's collectives land; {len(ov_shells)} shell "
+                "slab(s) of width radius×K patch the faces from the "
+                "post-exchange state",
+                detail={"core": {d: list(v)
+                                 for d, v in sorted(ov_core.items())},
+                        "shells": [[d, lo, hi]
+                                   for d, lo, hi in ov_shells],
+                        "setting": setting})
+        elif ov_reasons is not None:
+            why = "; ".join(r.get("cause", r.get("code", ""))
+                            for r in ov_reasons)
+            if setting == "on":
+                report.add(
+                    "OVERLAP-INFEASIBLE", "error",
+                    f"overlap_x=on is forced but the core/shell split "
+                    f"cannot engage: {why} — the build would raise; "
+                    "use auto (falls back to the serial schedule) or "
+                    "fix the geometry",
+                    detail={"reasons": ov_reasons})
+            else:
+                report.add(
+                    "OVERLAP-OFF", "info",
+                    f"overlapped halo exchange stays off "
+                    f"(overlap_x={setting}): {why}",
+                    detail={"reasons": ov_reasons})
+
     # Distributed skew-margin proof: each dim the profit gate would
     # engage (restricted to unsharded dims) needs K·r left and r+E_sk
     # right inside the radius×K ghost pads — right-cover holds exactly

@@ -246,7 +246,13 @@ def run_harness(argv: Optional[List[str]] = None, out=None) -> int:
                    # pair's spread even after the one re-time: the split
                    # is noise — marked, not banked as evidence
                    **({"halo_cal_unstable": True}
-                      if st.get_halo_cal_unstable() else {})})
+                      if st.get_halo_cal_unstable() else {}),
+                   # share of the bare collective cost the schedule hid
+                   # (the overlapped core/shell split should push this
+                   # toward 1; the serial arm shows XLA's baseline)
+                   **({"halo_overlap_eff":
+                       round(st.get_halo_overlap_eff(), 4)}
+                      if st.get_halo_overlap_eff() > 0 else {})})
         out.write(f"ledger: recorded '{key}' "
                   f"(guard {row['guard'].get('status')})\n")
     return 0

@@ -480,7 +480,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                        unsharded_dims=None,
                        max_skew_dims: int = 2,
                        plan_only: bool = False,
-                       reasons: Optional[List[dict]] = None):
+                       reasons: Optional[List[dict]] = None,
+                       region: Optional[Dict[str, Tuple[int, int]]] = None):
     """Build ``chunk(state, t0) -> state`` advancing ``fuse_steps`` steps
     in one fused Pallas sweep.
 
@@ -530,6 +531,22 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     by the static checker's explain pass.  ``plan_only=True`` stops
     after planning (no kernel is traced, nothing allocates) and returns
     the plan dict instead of ``(chunk, tile_bytes)``.
+
+    ``region`` restricts the OUTPUT sub-range per leading dim to
+    ``{dim: (lo, hi)}`` in interior coordinates: the grid covers only
+    the restricted span, fetch margins are re-derived from the
+    restricted origin, and the global-coordinate mask stays exact.
+    This is the core/shell split primitive of the overlapped
+    shard_pallas exchange schedule (the fused-chunk analog of the
+    reference's interior/exterior MPI overlap, ``context.cpp:377-478``).
+    Correctness contract for callers: only interior cells inside the
+    region (plus ceil-coverage window overshoot, whose values are NOT
+    valid) are written — the scheduler must patch every cell outside
+    the region from another chunk's output before use.  A restricted
+    dim that is some written var's sublane axis must have a
+    ``sub_t``-aligned ``lo`` (output DMA windows keep 8-aligned
+    offsets on real Mosaic — raises otherwise), and restricted dims
+    never skew (their carry geometry assumes the full span).
     """
     import jax
     import jax.numpy as jnp
@@ -579,6 +596,49 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         ring_read_vars.update(sr_.keys())
     from yask_tpu.compiler.lowering import tpu_tile_dims
     sub_t, _lane_t = tpu_tile_dims(program.dtype)
+
+    # ---- region restriction (core/shell split) -------------------------
+    # reg_lo shifts every window origin; span replaces sizes[d] in the
+    # grid/coverage math.  The minor dim always rides whole (lane-axis
+    # windows cannot restrict), and a restricted dim that is a written
+    # var's sublane axis needs a sub_t-aligned lower bound or the output
+    # DMA offsets become 8-unaligned — a hardware-only crash the CPU
+    # interpreter cannot catch, so it is rejected statically here.
+    region = dict(region) if region else {}
+    for d, bounds in region.items():
+        if d not in lead:
+            raise YaskException(
+                f"region restriction on '{d}' is not a leading domain "
+                f"dim of this solution ({lead}); the minor (lane) dim "
+                "always rides whole")
+        lo_, hi_ = bounds
+        if not (0 <= lo_ < hi_ <= sizes[d]):
+            raise YaskException(
+                f"region ({lo_},{hi_}) in dim '{d}' outside the "
+                f"interior [0,{sizes[d]})")
+    reg_lo = {d: region.get(d, (0, sizes[d]))[0] for d in lead}
+    span = {d: (region.get(d, (0, sizes[d]))[1]
+                - region.get(d, (0, sizes[d]))[0]) for d in lead}
+    restricted = {d for d in lead
+                  if (reg_lo[d], span[d]) != (0, sizes[d])}
+    if restricted:
+        sub_constrained = set()
+        for g_ in program.geoms.values():
+            if g_.is_scratch or len(g_.axes) < 2:
+                continue
+            dn_, kind_ = g_.axes[-2]
+            if kind_ == "domain" and dn_ != minor:
+                sub_constrained.add(dn_)
+        for d in restricted & sub_constrained:
+            if reg_lo[d] % sub_t != 0:
+                raise YaskException(
+                    f"region lower bound {reg_lo[d]} in dim '{d}' is "
+                    f"not a multiple of the sublane tile {sub_t}: "
+                    "output DMA windows would be 8-unaligned on real "
+                    "Mosaic (align the core/shell split boundaries)")
+        reasons.append({"code": "region_restricted",
+                        "region": {d: list(region[d])
+                                   for d in sorted(restricted)}})
     # carry depth per var = its ring allocation (an upper bound on how
     # many sub-steps back its levels are read).  The per-level write
     # windows shift by r per sub-step; the stream dim is the sublane
@@ -606,6 +666,12 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             unsharded_dims = ({sdim} if (stream_unsharded
                                          and sdim is not None) else set())
     unsharded_dims = set(unsharded_dims)
+    # restricted dims never skew: their carry buffers and shifted write
+    # windows assume the full span.  (In the distributed overlap split
+    # this is automatic — restricted dims are the sharded dims — but a
+    # direct caller could combine them; removing them from the eligible
+    # set makes forced skew on a restricted dim raise below.)
+    unsharded_dims -= restricted
     if isinstance(skew, (list, tuple, set, frozenset)) and not skew:
         skew = False   # an explicit empty dim list = uniform shrink
     forced = skew is True or isinstance(skew, (list, tuple, set,
@@ -721,7 +787,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                             vinstr_cap=vinstr_cap, min_block=smin,
                             margin_override=smarg)
     else:
-        block = {d: min(b, sizes[d]) for d, b in zip(lead, block)}
+        block = {d: min(b, span[d]) for d, b in zip(lead, block)}
 
     # ---- Mosaic DMA slab geometry ---------------------------------------
     # HBM memrefs carry a tiled (sublane×lane) layout; DMA windows must
@@ -745,16 +811,17 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                          if not g.is_scratch]
 
     def _gcount(d, b):
-        """Grid extent in dim d: ceil coverage; each skewed dim needs
-        (K−1)·r more tiles on the right because the final-level write
-        regions sit shifted left by (K−1)·r."""
-        span = sizes[d] + ((K - 1) * R[d] if d in skew_set else 0)
-        return -(-span // b)
+        """Grid extent in dim d: ceil coverage of the (possibly
+        region-restricted) span; each skewed dim needs (K−1)·r more
+        tiles on the right because the final-level write regions sit
+        shifted left by (K−1)·r (skew and region are disjoint)."""
+        sp = span[d] + ((K - 1) * R[d] if d in skew_set else 0)
+        return -(-sp // b)
 
     def _slab_geom(g, d, b):
         """(base, resid, slab_size) of dim-d windows for var g at block
-        size b."""
-        s = g.origin[d] - mL[d]
+        size b (window origins shift by the region's lower bound)."""
+        s = g.origin[d] + reg_lo[d] - mL[d]
         if _sub_dim(g) == d:
             base = (s // sub_t) * sub_t
             r = s - base
@@ -770,7 +837,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         for g in non_scratch_geoms:
             if d not in g.domain_dims:
                 continue
-            if g.origin[d] - mL[d] < 0:
+            if g.origin[d] + reg_lo[d] - mL[d] < 0:
                 return False
             base, _r, sz = _slab_geom(g, d, b)
             if (gcount - 1) * b + base + sz > g.shape[g.axis_of(d)]:
@@ -780,7 +847,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     def _fit_block(d, b):
         sub = any(_sub_dim(g) == d for g in non_scratch_geoms)
         step = sub_t if sub else 1
-        b = max(step, min(b, sizes[d]))
+        b = max(step, min(b, span[d]))
         if sub:
             b = max(step, (b // step) * step)
         while b > step and not _overshoot_ok(d, b):
@@ -810,7 +877,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             vinstr_cap=vinstr_cap, stream_unsharded=stream_unsharded,
             unsharded_dims=unsharded_dims,
             max_skew_dims=max(len(skew_dims) - 1, 0),
-            plan_only=plan_only, reasons=reasons)
+            plan_only=plan_only, reasons=reasons, region=region or None)
 
     try:
         _block_req = dict(block)
@@ -1042,6 +1109,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             "total_steps": total_steps,
             "skew": bool(use_skew),
             "skew_dims": list(skew_dims),
+            "region": {d: list(region[d]) for d in sorted(restricted)},
             "mL": dict(mL), "mR": dict(mR), "E": dict(E),
             "radius": dict(rad),
             "sizes": dict(sizes),
@@ -1184,7 +1252,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                             src_idxs.append(pl.ds(
                                 mL[dn] + resid[name, dn], block[dn]))
                             dst_idxs.append(pl.ds(
-                                g.origin[dn] + coords[di] * block[dn],
+                                g.origin[dn] + reg_lo[dn]
+                                + coords[di] * block[dn],
                                 block[dn]))
                     cps.append(pltpu.make_async_copy(
                         sref.at[tuple(src_idxs)],
@@ -1352,8 +1421,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 return padded
             return jnp.where(mask, padded, base)
 
-        ev.gidx_base = {d: pid[lead.index(d)] * block[d] - mL[d]
-                        for d in lead}
+        ev.gidx_base = {d: pid[lead.index(d)] * block[d]
+                        + reg_lo[d] - mL[d] for d in lead}
         if distributed:
             for di, d in enumerate(dims):
                 ev.gidx_base[d] = ev.gidx_base.get(d, 0) + off_ref[di]
@@ -1494,7 +1563,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                     # 1-D iota (probed on TPU v5e)
                     gidx = (lax.broadcasted_iota(
                                 jnp.int32, tuple(shape), di)
-                            + lo + pid[di] * block[d] - mL[d])
+                            + lo + pid[di] * block[d]
+                            + reg_lo[d] - mL[d])
                     if distributed:
                         gidx = gidx + off_ref[di]
                         bound = gdom[d]
@@ -1777,6 +1847,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     chunk.tiling = {"fuse_steps": K, "block": dict(block),
                     "skew": bool(use_skew),
                     "skew_dims": list(skew_dims),
+                    "region": ({d: list(region[d]) for d in sorted(restricted)}
+                               if restricted else None),
                     "pipeline_dmas": use_pipe,
                     "pipeline_out": use_pipe_out,
                     "tile_bytes": tile_bytes,

@@ -92,6 +92,107 @@ def _no_exchange(arr, geom, dim_widths, nr, local_sizes):
     return arr
 
 
+def overlap_decision(ctx, K: int, local_prog=None):
+    """Shared engage/reject decision for the overlapped shard_pallas
+    exchange schedule (the core/shell split of the fused K-group).
+
+    Returns ``(engage, core, shells, reasons)`` where ``core`` is the
+    region dict ``{dim: (lo, hi)}`` for the core chunk, ``shells`` is a
+    list of ``(dim, lo, hi)`` face slabs, and ``reasons`` carries the
+    structured engage/reject codes the explain pass surfaces.  Pure
+    geometry, never raises for infeasibility (``_prep_shard_pallas``
+    raises only when the setting forces ``"on"``); the static checker's
+    OVERLAP rule calls this same function so the two can never drift.
+
+    Eligibility: setting not ``"off"``, at least one mesh-decomposed
+    leading dim with a nonzero fused ghost width ``hK = radius×K``, the
+    minor (lane) dim unsharded (lane-axis windows cannot restrict), and
+    per sharded dim an aligned core span — ``lo = align_up(hK)``,
+    ``hi = align_down(lsize − hK)`` with the sublane tile as the unit
+    when the dim is some var's sublane axis (output DMA offsets must
+    stay 8-aligned on real Mosaic) — of at least one alignment unit.
+    The auto gate therefore engages exactly when every sharded dim's
+    rank domain admits a core shrunk by ≥ hK per face (≈ 2·hK total).
+    """
+    opts = ctx._opts
+    ana = ctx._ana
+    dims = ana.domain_dims
+    minor = dims[-1]
+    nr = {d: opts.num_ranks[d] for d in dims}
+    lsizes = opts.rank_domain_sizes
+    rad = ana.fused_step_radius()
+    hK = {d: rad.get(d, 0) * K for d in dims}
+    setting = getattr(opts, "overlap_exchange", "auto")
+    reasons: List[dict] = []
+
+    if setting == "off":
+        reasons.append({"code": "overlap_disabled",
+                        "cause": "overlap_exchange=off"})
+        return False, None, None, reasons
+    if K < 2:
+        # a K=1 group is one fused step: there is no core compute
+        # window left to hide the exchange under, so the split buys
+        # nothing (and single-step groups run whole on post-exchange
+        # state inside the overlapped schedule — see ov_group)
+        reasons.append({
+            "code": ("overlap_infeasible" if setting == "on"
+                     else "overlap_ineligible"),
+            "cause": "wf_steps=1: a single-step group leaves no core "
+                     "compute to overlap the exchange with"})
+        return False, None, None, reasons
+    if nr.get(minor, 1) > 1:
+        reasons.append({"code": "overlap_ineligible",
+                        "cause": f"minor dim '{minor}' is sharded "
+                                 "(lane-axis windows cannot restrict)"})
+        return False, None, None, reasons
+    sharded = [d for d in dims[:-1] if nr.get(d, 1) > 1 and hK[d] > 0]
+    if not sharded:
+        reasons.append({"code": "overlap_ineligible",
+                        "cause": "no sharded leading dim with a "
+                                 "nonzero fused ghost width"})
+        return False, None, None, reasons
+
+    if local_prog is None:
+        local_prog = ctx._csol.plan(
+            lsizes, global_sizes=opts.global_domain_sizes,
+            extra_pad={d: (hK[d], hK[d]) for d in dims})
+    # Dims that are some var's sublane axis: split boundaries there
+    # must ride the sublane tile (same rule build_pallas_chunk enforces
+    # statically for its output DMA windows).
+    from yask_tpu.compiler.lowering import tpu_tile_dims
+    sub_t, _lane_t = tpu_tile_dims(local_prog.dtype)
+    sub_dims = set()
+    for g in local_prog.geoms.values():
+        if g.is_scratch or len(g.axes) < 2:
+            continue
+        dn, kind = g.axes[-2]
+        if kind == "domain" and dn != minor:
+            sub_dims.add(dn)
+
+    core: Dict[str, Tuple[int, int]] = {}
+    shells: List[Tuple[str, int, int]] = []
+    for d in sharded:
+        q = sub_t if d in sub_dims else 1
+        lo = -(-hK[d] // q) * q
+        hi = ((lsizes[d] - hK[d]) // q) * q
+        if hi - lo < q:
+            reasons.append({
+                "code": ("overlap_infeasible" if setting == "on"
+                         else "overlap_ineligible"),
+                "cause": f"dim '{d}': aligned core span [{lo},{hi}) is "
+                         f"empty — rank domain {lsizes[d]} cannot "
+                         f"cover 2×hK={2 * hK[d]} plus alignment "
+                         f"(unit {q})", "dim": d})
+            return False, None, None, reasons
+        core[d] = (lo, hi)
+        shells.append((d, 0, lo))
+        shells.append((d, hi, lsizes[d]))
+    reasons.append({"code": "overlap_engaged",
+                    "core": {d: list(core[d]) for d in sorted(core)},
+                    "hK": {d: hK[d] for d in sorted(core)}})
+    return True, core, shells, reasons
+
+
 def _make_overlap_step(prog, nr, lsizes, exchange=exchange_ghosts):
     """Interior/exterior-split step: the reference's compute/communication
     overlap (``run_solution`` exterior-then-interior structure,
@@ -295,16 +396,26 @@ def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
         t = jnp.asarray(start, dtype=jnp.int32)
         st = f(st, t)           # warmup (compile + first dispatch)
         jax.block_until_ready(st)
-        # repeat until the sample is long enough to be stable
+        # Repeat until the sample is long enough to be stable.  The
+        # call cap auto-scales: a sub-ms dispatch used to exhaust
+        # max_calls with the window still far below min_secs, and the
+        # (real − twin) subtraction then banked pure jitter — so when
+        # the cap is hit short, extend it by the measured per-call
+        # rate (bounded, so a hung dispatch can't loop forever).
         calls = 0
+        cap = max_calls
         t0 = time.perf_counter()
-        while calls < max_calls:
+        while calls < cap:
             st = f(st, t)
             jax.block_until_ready(st)
             calls += 1
-            if time.perf_counter() - t0 >= min_secs \
-                    and calls >= min_calls:
+            el = time.perf_counter() - t0
+            if el >= min_secs and calls >= min_calls:
                 break
+            if calls == cap and el < min_secs and cap < 1024:
+                per = el / calls
+                cap = min(1024, calls
+                          + int((min_secs - el) / max(per, 1e-9)) + 1)
         return (time.perf_counter() - t0) / calls
 
     def _is_outlier(samples):
@@ -343,6 +454,7 @@ def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
     ctx._halo_frac[key] = max(0.0, 1.0 - t_no / t_ex) if t_ex > 0 else 0.0
     ctx._halo_cal_spread[key] = max(sp_no, sp_ex)
     ctx._halo_cal_unstable[key] = bool(un_no or un_ex)
+    ctx._halo_tcall[key] = t_ex
     if fn_xonly is not None:
         ctx._halo_xround[key] = timed(fn_xonly)
     if fn_pack is not None:
@@ -638,6 +750,7 @@ def run_shard_map(ctx, start: int, n: int) -> None:
         ctx._halo_xpack_last = ctx._halo_xpack.get(key, 0.0)
         ctx._halo_cal_spread_last = ctx._halo_cal_spread.get(key, 0.0)
         ctx._halo_cal_unstable_last = ctx._halo_cal_unstable.get(key, False)
+        ctx._halo_overlap_eff_last = 0.0   # shard_pallas-only metric
         cal_secs = time.perf_counter() - t0cal
 
     t0c2 = time.perf_counter()
@@ -741,6 +854,85 @@ def _prep_shard_pallas(ctx, n: int, K: int, blk):
         f"skew={chunk.tiling['skew']}, "
         f"margin_overhead={chunk.tiling['margin_overhead']}")
 
+    # ---- overlapped exchange schedule (core/shell split) ---------------
+    # The core chunk covers the interior shrunk by hK per sharded face
+    # and is evaluated against PRE-exchange state: its reads stay inside
+    # [core_lo−hK, core_hi+hK) ⊆ the interior, so it carries no data
+    # dependence on the ppermutes and XLA overlaps the previous group's
+    # collectives with it.  The width-hK shell slabs then run on the
+    # post-exchange state — the reference's exterior/interior MPI
+    # overlap (context.cpp:377-478) at the fused-chunk level.
+    ngroups = groups + (1 if rem else 0)
+    ov_engage, ov_core, ov_shells, ov_reasons = \
+        overlap_decision(ctx, K, local_prog=local_prog)
+    ov_setting = getattr(opts, "overlap_exchange", "auto")
+    if ov_setting == "on" and not ov_engage:
+        raise YaskException(
+            "overlap_exchange=on but the core/shell split is "
+            "infeasible: " + "; ".join(
+                r.get("cause", r["code"]) for r in ov_reasons))
+    if ov_engage and ngroups < 2:
+        ov_engage = False
+        ov_reasons.append({"code": "overlap_inactive",
+                           "cause": f"single K-group (n={n} ≤ K={K}): "
+                                    "no exchange to overlap"})
+    chunk_core = chunk_core_rem = None
+    shell_chunks: List = []
+    shell_chunks_rem: List = []
+    if ov_engage:
+        def _build_split(fs):
+            core_c, _ = build_pallas_chunk(
+                local_prog, fuse_steps=fs, block=blk, interpret=interp,
+                distributed=True, vmem_budget=budget,
+                vinstr_cap=ctx._opts.max_tile_vinstr, skew=skw,
+                unsharded_dims=unsh,
+                max_skew_dims=ctx._opts.skew_dims_max, region=ov_core)
+            sh_cs = []
+            for d, a, b in ov_shells:
+                sc, _ = build_pallas_chunk(
+                    local_prog, fuse_steps=fs, block=blk,
+                    interpret=interp, distributed=True,
+                    vmem_budget=budget,
+                    vinstr_cap=ctx._opts.max_tile_vinstr, skew=skw,
+                    unsharded_dims=unsh,
+                    max_skew_dims=ctx._opts.skew_dims_max,
+                    region={d: (a, b)})
+                sh_cs.append(sc)
+            return core_c, sh_cs
+        try:
+            chunk_core, shell_chunks = _build_split(K)
+            if rem >= 2:
+                chunk_core_rem, shell_chunks_rem = _build_split(rem)
+            elif rem:
+                # a 1-step remainder group has no core compute window:
+                # ov_group runs the whole chunk_rem on post-exchange
+                # state (core_fn None), keeping bit-equality with the
+                # serial schedule
+                ov_reasons.append({
+                    "code": "overlap_rem_unsplit",
+                    "cause": "remainder group fuses a single step: run "
+                             "whole on post-exchange state (no compute "
+                             "to hide its exchange under)"})
+        except YaskException as e:
+            # the split planner rejected a region (e.g. an unalignable
+            # boundary): fall back to the serial schedule unless forced
+            if ov_setting == "on":
+                raise
+            ov_engage = False
+            chunk_core = chunk_core_rem = None
+            ov_reasons.append({"code": "overlap_fallback",
+                               "cause": str(e)})
+        else:
+            ctx._env.trace_msg(
+                f"shard_pallas overlap: core="
+                f"{ {d: list(v) for d, v in ov_core.items()} }, "
+                f"{len(ov_shells)} shell slab(s)")
+    chunk.tiling["overlap_exchange"] = bool(ov_engage)
+    chunk.tiling["overlap_reasons"] = list(ov_reasons)
+    if ov_engage:
+        chunk.tiling["overlap_core"] = {d: list(v)
+                                        for d, v in ov_core.items()}
+
     def build(exchange):
         """shard_map program with the given exchange implementation —
         the no-exchange twin drives halo-time calibration exactly as in
@@ -801,6 +993,20 @@ def _prep_shard_pallas(ctx, n: int, K: int, blk):
                 state[k] = [jnp.pad(a, pads) if pads else a
                             for a in interior_state[k]]
 
+            def _strip(st):
+                out = {}
+                for k in names:
+                    g = local_prog.geoms[k]
+                    idxs = []
+                    for dn, kind in g.axes:
+                        if kind == "domain":
+                            idxs.append(slice(g.origin[dn],
+                                              g.origin[dn] + lsizes[dn]))
+                        else:
+                            idxs.append(slice(None))
+                    out[k] = [a[tuple(idxs)] for a in st[k]]
+                return out
+
             # 2) one full exchange up front, then per K-group the fused
             #    chunk runs and only its freshly produced slots (whose
             #    pads it re-zeroed) are re-exchanged — read-only vars and
@@ -808,33 +1014,100 @@ def _prep_shard_pallas(ctx, n: int, K: int, blk):
             #    unrolled so no exchange is wasted after the last group.
             state = exchange_all(state)
 
+            if not ov_engage:
+                def group(carry, _):
+                    st, t = carry
+                    st = chunk(st, t, off_vec)
+                    st = exchange_newest(st)
+                    return (st, t + K * dirn), None
+
+                nscan = groups if rem else groups - 1
+                (state, t), _ = lax.scan(group, (state, t0), None,
+                                         length=nscan)
+                if rem:
+                    state = chunk_rem(state, t, off_vec)
+                else:
+                    state = chunk(state, t, off_vec)
+                return _strip(state)
+
+            # Overlapped schedule: group 0 runs the plain chunk on the
+            # fully exchanged state; each later group exchanges FIRST,
+            # then evaluates the core against the pre-exchange state
+            # (its reads stay ≥ hK from every sharded face, so the
+            # ppermutes are not on its dataflow and XLA overlaps them)
+            # and the shell slabs against the post-exchange state.
+            # Same T−1 exchanges as the serial schedule, moved from the
+            # group tails to the heads.
+            def ov_group(st, t, core_fn, shell_fns, gk):
+                st_post = exchange_newest(st)
+                if core_fn is None:
+                    # single-step group (K=1 remainder): one fused step
+                    # leaves no core compute window to hide its exchange
+                    # under, and the split would trade bit-equality with
+                    # the serial schedule for nothing — run the whole
+                    # chunk on the post-exchange state instead (same
+                    # exchange placement, same values to the last bit).
+                    fo = (chunk if gk == K else chunk_rem)(
+                        st_post, t, off_vec)
+                    out = {}
+                    for k in names:
+                        g = local_prog.geoms[k]
+                        if not g.is_written:
+                            out[k] = list(st_post[k])
+                            continue
+                        L = len(st_post[k])
+                        nb = min(gk, L)
+                        out[k] = (list(st_post[k][nb:])
+                                  + list(fo[k][L - nb:]))
+                    return out
+                core_out = core_fn(st, t, off_vec)
+                shell_outs = [fn(st_post, t, off_vec)
+                              for fn in shell_fns]
+                new_state = {}
+                for k in names:
+                    g = local_prog.geoms[k]
+                    if not g.is_written:
+                        new_state[k] = list(st_post[k])
+                        continue
+                    L = len(st_post[k])
+                    nback = min(gk, L)
+                    merged = []
+                    for s in range(L - nback, L):
+                        a = core_out[k][s]
+                        for (d, lo, hi), sh in zip(ov_shells,
+                                                   shell_outs):
+                            if d not in g.domain_dims:
+                                # a var without the split dim is
+                                # d-invariant (missing-dim race rule):
+                                # the core's copy is already complete
+                                continue
+                            idx = [slice(None)] * a.ndim
+                            idx[g.axis_of(d)] = slice(
+                                g.origin[d] + lo, g.origin[d] + hi)
+                            a = a.at[tuple(idx)].set(
+                                sh[k][s][tuple(idx)])
+                        merged.append(a)
+                    # surviving (rotated-forward) slots must come from
+                    # st_post — they keep their exchanged pads; the
+                    # core output's cells outside its region windows
+                    # are unwritten
+                    new_state[k] = list(st_post[k][nback:]) + merged
+                return new_state
+
+            state = chunk(state, t0, off_vec)
+
             def group(carry, _):
                 st, t = carry
-                st = chunk(st, t, off_vec)
-                st = exchange_newest(st)
+                st = ov_group(st, t, chunk_core, shell_chunks, K)
                 return (st, t + K * dirn), None
 
-            nscan = groups if rem else groups - 1
-            (state, t), _ = lax.scan(group, (state, t0), None,
-                                     length=nscan)
+            (state, t), _ = lax.scan(
+                group, (state, t0 + K * dirn), None,
+                length=groups - 1)
             if rem:
-                state = chunk_rem(state, t, off_vec)
-            else:
-                state = chunk(state, t, off_vec)
-
-            # 3) strip pads.
-            out = {}
-            for k in names:
-                g = local_prog.geoms[k]
-                idxs = []
-                for dn, kind in g.axes:
-                    if kind == "domain":
-                        idxs.append(slice(g.origin[dn],
-                                          g.origin[dn] + lsizes[dn]))
-                    else:
-                        idxs.append(slice(None))
-                out[k] = [a[tuple(idxs)] for a in state[k]]
-            return out
+                state = ov_group(state, t, chunk_core_rem,
+                                 shell_chunks_rem, rem)
+            return _strip(state)
 
         try:
             return shard_map(body, mesh=mesh, in_specs=in_specs,
@@ -979,6 +1252,22 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
         ctx._halo_xpack_last = ctx._halo_xpack.get(key, 0.0)
         ctx._halo_cal_spread_last = ctx._halo_cal_spread.get(key, 0.0)
         ctx._halo_cal_unstable_last = ctx._halo_cal_unstable.get(key, False)
+        # Overlap efficiency: the serial model pays rounds × bare
+        # exchange cost per call; the measured halo cost is frac ×
+        # t_call.  Their shortfall is the share of the bare collective
+        # cost the schedule hid (XLA overlap) — the reference derives
+        # the same number from its exterior/interior MPI timers.
+        if key not in ctx._halo_overlap_eff:
+            g_, r_ = divmod(n, K)
+            rounds = g_ + (1 if r_ else 0) - 1
+            t_x = ctx._halo_xround.get(key, 0.0)
+            t_call = ctx._halo_tcall.get(key, 0.0)
+            eff = 0.0
+            if rounds > 0 and t_x > 0 and t_call > 0:
+                eff = max(0.0, min(1.0, 1.0 - (frac * t_call)
+                                   / (rounds * t_x)))
+            ctx._halo_overlap_eff[key] = eff
+        ctx._halo_overlap_eff_last = ctx._halo_overlap_eff.get(key, 0.0)
 
     ctx._resident = None   # interior buffers are donated next; any
     #                          failure before this point kept them valid

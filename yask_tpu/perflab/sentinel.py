@@ -96,6 +96,20 @@ CUBE_WAVEFRONT_FLOOR = 1.5
 #: once tpu_session banks them.
 SKEW2D_SPEEDUP_FLOOR = 0.75
 
+#: PROVISIONAL floor for the shard_pallas overlapped-halo-exchange A/B
+#: (bench_suite ``sp-overlap-speedup``: core/shell split forced on vs
+#: the serial chunk→exchange schedule).  The failure class: the split
+#: costs two extra kernel launches and a merge per K-group, so a
+#: schedule bug (or a core region mis-shrunk to nothing) shows as the
+#: ratio collapsing.  TPU-scoped: the CPU proxy measures 0.68–0.81×
+#: BY CONSTRUCTION (ppermutes are same-host memcpys — there is no
+#: collective latency to hide, only the extra launches to pay), so a
+#: floor there would alarm on every suite run; the CPU arm stays
+#: under the trailing-median backstop instead.  Re-base from clean
+#: TPU rows once tpu_session banks the overlap_ab stage — on hardware
+#: the ratio is the point of the feature and should clear 1.
+SP_OVERLAP_SPEEDUP_FLOOR = 0.95
+
 DEFAULT_RULES: List[GuardRule] = [
     GuardRule(name="iso3dfd-128-jit-floor",
               pattern="128^3 fp32 cpu throughput",
@@ -107,6 +121,10 @@ DEFAULT_RULES: List[GuardRule] = [
     GuardRule(name="skew2d-speedup-floor",
               pattern="skew2d-speedup",
               floor=SKEW2D_SPEEDUP_FLOOR, rel_tol=0.25),
+    GuardRule(name="sp-overlap-speedup-floor",
+              pattern="sp-overlap-speedup",
+              floor=SP_OVERLAP_SPEEDUP_FLOOR, rel_tol=0.25,
+              platforms=("axon", "tpu")),
     # the backstop every throughput/speedup row gets: trailing clean
     # median, generous tolerance (CPU-proxy trial noise is real)
     GuardRule(name="trailing-median", rel_tol=0.35),

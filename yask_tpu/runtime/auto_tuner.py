@@ -500,14 +500,68 @@ class AutoTuner:
                                        "candidates; keeping current "
                                        "settings")
                     return ctx._opts.wf_steps
-                return self._finish_joint(best_key, best, lead)
+                best_k = self._finish_joint(best_key, best, lead)
+            else:
+                def walk_one(mb, ladder):
+                    return self._walk(make_measure(mb, ladder), k0,
+                                      self._start_point(k0), sizes,
+                                      lead, kmax)
 
-            def walk_one(mb, ladder):
-                return self._walk(make_measure(mb, ladder), k0,
-                                  self._start_point(k0), sizes, lead,
-                                  kmax)
+                best_k = self._walk_ladder(walk_one, lead)
 
-            return self._walk_ladder(walk_one, lead)
+            # Overlapped halo exchange on/off as the final axis of the
+            # joint walk, A/B'd at the winning (K, blocks, vmem) point.
+            # The walk's own trials run one K-group per call (n=K),
+            # where there is no second group to overlap — both
+            # schedules compile to the same program — so the arms are
+            # timed on TWO-group calls (n=2K, one mid-call exchange
+            # round) where the core/shell split can actually hide the
+            # collectives.  Only when the setting is "auto" (an
+            # explicit on/off is the user's call, not the tuner's) and
+            # the geometry admits an aligned core.
+            if getattr(ctx._opts, "overlap_exchange", None) == "auto":
+                from yask_tpu.parallel.shard_step import overlap_decision
+                kw = max(ctx._opts.wf_steps, 1)
+                ov_ok, _, _, _ = overlap_decision(ctx, kw)
+                if ov_ok:
+                    blkw = tuple(ctx._opts.block_sizes[d] for d in lead)
+                    mbw = ctx._opts.vmem_budget_mb
+                    rates = {}
+                    try:
+                        for ov in (False, True):
+                            ctx._opts.overlap_exchange = ("on" if ov
+                                                          else "off")
+
+                            def mk():
+                                return get_shard_pallas_fn(
+                                    ctx, trial, t_trial, n=2 * kw,
+                                    K=kw, blk=blkw)
+
+                            def call(fn):
+                                nonlocal trial, t_trial
+                                st = fn(trial, jnp.asarray(
+                                    t_trial, dtype=jnp.int32))
+                                jax.block_until_ready(st)
+                                trial = st
+                                t_trial += 2 * kw * dirn
+                            rates[ov] = self._measure(
+                                ("sp", kw, blkw, mbw, ov), mk,
+                                call=call, k=2 * kw)
+                    finally:
+                        ctx._opts.overlap_exchange = "auto"
+                    r_on = rates.get(True, float("inf"))
+                    r_off = rates.get(False, float("inf"))
+                    if r_on != float("inf") or r_off != float("inf"):
+                        win = r_on < r_off
+                        ctx._opts.overlap_exchange = ("on" if win
+                                                      else "off")
+                        ctx._env.trace_msg(
+                            f"auto-tuner: overlap_x="
+                            f"{'on' if win else 'off'} "
+                            f"(on {r_on * 1e3:.3f} vs off "
+                            f"{r_off * 1e3:.3f} ms/step, "
+                            f"2-group trials)")
+            return best_k
         finally:
             for key in set(ctx._jit_cache) - keys_before:
                 if key[0] == "shard_pallas":
@@ -530,3 +584,20 @@ class AutoTuner:
             # vmem-ladder result: pin the winning budget so replays
             # compile with the rung the measurement actually used
             self.ctx._opts.vmem_budget_mb = best[2]
+        if not hasattr(self.ctx._opts, "overlap_exchange"):
+            return
+        if len(best) > 3 and best[3] is not None:
+            # overlap A/B result (shard_pallas): pin the winning arm —
+            # best[3] is the boolean overlap flag of the timed trial
+            self.ctx._opts.overlap_exchange = "on" if best[3] else "off"
+        else:
+            # The walk's one-group trials (no exchange to overlap) can
+            # out-rate the two-group A/B arms on raw ms/step, leaving
+            # the global best without an overlap element; the A/B still
+            # answered the question — pin the faster arm at the chosen
+            # K so replays get the schedule the walk decided on.
+            arms = {k[4]: v for k, v in feasible.items()
+                    if len(k) == 5 and k[0] == "sp" and k[1] == best[0]}
+            if arms:
+                self.ctx._opts.overlap_exchange = (
+                    "on" if min(arms, key=arms.get) else "off")

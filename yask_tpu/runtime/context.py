@@ -350,10 +350,13 @@ class StencilContext:
         self._halo_xpack = {}        # key -> secs pack-only (no collective)
         self._halo_cal_spread = {}   # key -> rel spread of the twin trials
         self._halo_cal_unstable = {}  # key -> outliers survived re-time
+        self._halo_tcall = {}        # key -> secs per full timed call
+        self._halo_overlap_eff = {}  # key -> hidden collective fraction
         self._halo_xround_last = 0.0
         self._halo_xpack_last = 0.0
         self._halo_cal_spread_last = 0.0
         self._halo_cal_unstable_last = False
+        self._halo_overlap_eff_last = 0.0
         for h in self._hooks["after_prepare"]:
             h(self)
 
@@ -706,7 +709,8 @@ class StencilContext:
         o = self._opts
         skw = None if o.skew_wavefront else False
         sdm = o.skew_dims_max if o.skew_wavefront else 0
-        return (skw, sdm, o.vmem_budget_mb)
+        ovx = getattr(o, "overlap_exchange", "auto")
+        return (skw, sdm, o.vmem_budget_mb, ovx)
 
     def _pallas_build_key(self, K: int):
         """(cache key, block tuple, skew arg) for the configured pallas
@@ -1048,6 +1052,7 @@ class StencilContext:
             halo_pack_secs=self._halo_xpack_last,
             halo_cal_spread=self._halo_cal_spread_last,
             halo_cal_unstable=self._halo_cal_unstable_last,
+            halo_overlap_eff=self._halo_overlap_eff_last,
             read_bytes_pp=rb_pp, write_bytes_pp=wb_pp,
             # aggregate peak: throughput is global (all chips), so the
             # roofline denominator must scale with the mesh size

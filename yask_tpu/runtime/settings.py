@@ -79,6 +79,16 @@ class KernelSettings:
         # row; the multi-dim trapezoid analog of the reference's
         # wave-front tiling in multiple dims).
         self.skew_dims_max = 2
+        # Overlapped halo exchange on the shard_pallas path: split each
+        # fused K-group into a core chunk (interior shrunk by radius×K
+        # per sharded dim, evaluated against PRE-exchange state so XLA
+        # runs the previous group's collectives concurrently) + shell
+        # slabs on the post-exchange state — the fused-chunk analog of
+        # the reference's interior/exterior MPI overlap
+        # (context.cpp:377-478).  "auto" = on when every sharded dim's
+        # rank domain admits an aligned core (≥ 2·radius·K),
+        # "on" = force (raises when infeasible), "off" = serial.
+        self.overlap_exchange = "auto"
         # Let the joint auto-tuner sweep the Pallas VMEM budget
         # (64/96/120 MiB ladder) as an outer tuning axis when
         # vmem_budget_mb is 0 (auto).  Larger budgets admit wider
@@ -168,6 +178,11 @@ class KernelSettings:
             "skew_dims", "Max grid dims the skewed wavefront may "
             "engage (1 = stream dim only, 2 = also the second-inner "
             "dim).", self, "skew_dims_max")
+        parser.add_string_option(
+            "overlap_x", "shard_pallas overlapped halo exchange: "
+            "auto|on|off (core/shell split of the fused K-group; the "
+            "interior/exterior MPI-overlap analog).", self,
+            "overlap_exchange")
         parser.add_int_option(
             "vmem_mb", "Pallas VMEM budget in MiB (0 = derive from the "
             "device).", self, "vmem_budget_mb")

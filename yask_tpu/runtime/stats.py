@@ -18,6 +18,7 @@ class yk_stats:
                  halo_pack_secs: float = 0.0,
                  halo_cal_spread: float = 0.0,
                  halo_cal_unstable: bool = False,
+                 halo_overlap_eff: float = 0.0,
                  read_bytes_pp: float = 0.0, write_bytes_pp: float = 0.0,
                  hbm_peak: float = 0.0, tiling: dict | None = None):
         self._npts = npts
@@ -32,6 +33,7 @@ class yk_stats:
         self._halo_xpack = halo_pack_secs
         self._halo_cal_spread = halo_cal_spread
         self._halo_cal_unstable = halo_cal_unstable
+        self._halo_overlap_eff = halo_overlap_eff
         self._rb_pp = read_bytes_pp
         self._wb_pp = write_bytes_pp
         self._hbm_peak = hbm_peak
@@ -123,6 +125,16 @@ class yk_stats:
         logic ignores such rows."""
         return self._halo_cal_unstable
 
+    def get_halo_overlap_eff(self) -> float:
+        """Fraction of the bare collective cost the shard_pallas
+        schedule hid: 1 − measured-halo-cost / (rounds × bare exchange
+        round), clamped to [0, 1].  Nonzero for the serial arm too
+        (XLA hides some latency regardless); the overlapped core/shell
+        split should push it toward 1.  0 when the calibration is
+        missing or nothing was hidden — the MPI-overlap efficiency the
+        reference derives from its exterior/interior timers."""
+        return self._halo_overlap_eff
+
     def get_hbm_bytes_per_point(self) -> float:
         """Modeled HBM traffic (read+write) per point per step."""
         return self._rb_pp + self._wb_pp
@@ -154,7 +166,10 @@ class yk_stats:
                    if self._halo_cal_unstable else "")
                 + f"halo-collective (sec): "
                 f"{self.get_halo_collective_secs():.6g}\n"
-                f"hbm-bytes-per-point (read+write): "
+                + (f"halo-overlap-eff (%): "
+                   f"{100.0 * self._halo_overlap_eff:.4g}\n"
+                   if self._halo_overlap_eff > 0 else "")
+                + f"hbm-bytes-per-point (read+write): "
                 f"{self.get_hbm_bytes_per_point():.6g}\n"
                 f"achieved-HBM (GB/s): "
                 f"{self.get_hbm_bytes_per_sec() / 1e9:.6g}\n"
