@@ -24,7 +24,7 @@ def env():
 
 
 def _build(env, name, radius, mode, wf=1, blk=None, eb=4, ranks=(),
-           overlap=True, ovx=None):
+           overlap=True, ovx=None, trz=None):
     from yask_tpu.runtime.init_utils import init_solution_vars
     from yask_tpu.compiler.solution_base import create_solution
     fac = yk_factory()
@@ -41,6 +41,8 @@ def _build(env, name, radius, mode, wf=1, blk=None, eb=4, ranks=(),
     s.overlap_comms = overlap
     if ovx is not None:
         s.overlap_exchange = ovx
+    if trz is not None:
+        s.trapezoid_tiling = trz
     for d, b in (blk or {}).items():
         ctx.set_block_size(d, b)
     for d, r in ranks:
@@ -54,7 +56,7 @@ _jit_ref_cache = {}
 
 
 def _check(env, name, radius, mode, wf=1, blk=None, eb=4, ranks=(),
-           overlap=True, ovx=None):
+           overlap=True, ovx=None, trz=None):
     eps = (1e-3, 1e-4) if eb == 4 else (3e-2, 3e-2)
     key = (name, radius, eb)
     if key not in _jit_ref_cache:
@@ -68,7 +70,7 @@ def _check(env, name, radius, mode, wf=1, blk=None, eb=4, ranks=(),
                                     abs_epsilon=eps[1]) == 0
         _jit_ref_cache[key] = ref
     ctx = _build(env, name, radius, mode, wf=wf, blk=blk, eb=eb,
-                 ranks=ranks, overlap=overlap, ovx=ovx)
+                 ranks=ranks, overlap=overlap, ovx=ovx, trz=trz)
     ctx.run_solution(0, 1)
     assert ctx.compare_data(_jit_ref_cache[key], epsilon=eps[0],
                             abs_epsilon=eps[1]) == 0
@@ -124,6 +126,18 @@ def test_matrix_overlap_split(env, overlap, name, radius):
 @pytest.mark.parametrize("eb", [4, 2], ids=["fp32", "bf16"])
 def test_matrix_distributed_dtypes(env, eb):
     _check(env, "iso3dfd", 2, "shard_map", eb=eb, ranks=[("x", 4)])
+
+
+@pytest.mark.parametrize("trz", [True, False], ids=["trap", "notrap"])
+@pytest.mark.parametrize("name,radius,wf", [("iso3dfd", 2, 2),
+                                            ("cube", 1, 4)])
+def test_matrix_trapezoid(env, trz, name, radius, wf):
+    # trapezoid/diamond two-phase tiling as a matrix axis: the knob
+    # arms the auto profit gate (trapezoid=None at build); at g=24 the
+    # gate decides per config, and either outcome must stay bit-exact
+    # against the jit twin (the forced-path equivalence lives in
+    # tests/test_trapezoid.py)
+    _check(env, name, radius, "pallas", wf=wf, trz=trz)
 
 
 @pytest.mark.parametrize("ovx", ["on", "off", "auto"])

@@ -194,3 +194,120 @@ def test_resident_slice_fast_path(env):
     # the strict path must agree with what the fast path returned
     a_strict = v.get_elements_in_slice(*box)
     assert np.array_equal(a_strict, a_fast)
+
+
+# ---- region= builds under the pipelined write-back (r10 shell slabs) ----
+#
+# The overlap schedule's core/shell chunks are region-restricted builds;
+# the output-DMA pipeline (use_pipe_out) stages their writes through
+# parity-doubled VMEM tiles that retire two grid steps later.  A
+# region build changes the grid span and the write windows, so the
+# combination gets direct bit-equality coverage here: the region cells
+# of a restricted chunk must match the full build EXACTLY, with the
+# pipeline engaged on both sides.
+
+def _mk_single(env, g=(32, 48, 16), radius=2, wf=2):
+    from yask_tpu.runtime.init_utils import init_solution_vars
+    ctx = yk_factory().new_solution(env, stencil="iso3dfd", radius=radius)
+    gx, gy, gz = g
+    ctx.apply_command_line_options(f"-g_x {gx} -g_y {gy} -g_z {gz}")
+    s = ctx.get_settings()
+    s.mode = "pallas"
+    s.wf_steps = wf
+    ctx.prepare_solution()
+    init_solution_vars(ctx)
+    return ctx
+
+
+def _region_bit_equal(prog, out_full, out_reg, region, extent, wf):
+    """Region-interior cells of every written ring slot must agree to
+    the last bit (cells outside the region are contract-unwritten)."""
+    checked = 0
+    for k, g in prog.geoms.items():
+        if not g.is_written:
+            continue
+        L = len(out_full[k])
+        for s in range(L - min(wf, L), L):
+            a = np.asarray(out_full[k][s])
+            b = np.asarray(out_reg[k][s])
+            idx = [slice(None)] * a.ndim
+            for d in g.domain_dims:
+                lo, hi = region.get(d, (0, extent[d]))
+                idx[g.axis_of(d)] = slice(g.origin[d] + lo,
+                                          g.origin[d] + hi)
+            np.testing.assert_array_equal(a[tuple(idx)], b[tuple(idx)])
+            checked += 1
+    assert checked
+
+
+def test_region_core_box_pipe_out_bit_equal(env):
+    from yask_tpu.ops.pallas_stencil import build_pallas_chunk
+    g = (32, 48, 16)
+    ctx = _mk_single(env, g=g)
+    prog = ctx._program
+    blk = (8, 16)
+    region = {"x": (4, 28), "y": (8, 40)}     # core box (y lo 8-aligned)
+    full, _ = build_pallas_chunk(prog, fuse_steps=2, block=blk,
+                                 interpret=True, pipeline_dmas=True)
+    part, _ = build_pallas_chunk(prog, fuse_steps=2, block=blk,
+                                 interpret=True, pipeline_dmas=True,
+                                 region=region)
+    # the pipelined write-back must actually be engaged on both arms
+    assert full.tiling["pipeline_out"] is True
+    assert part.tiling["pipeline_out"] is True
+    assert part.tiling["region"] == {d: list(v)
+                                     for d, v in region.items()}
+    st = {k: list(v) for k, v in ctx._state.items()}
+    _region_bit_equal(prog, full(st, 0), part(st, 0), region,
+                      dict(zip(("x", "y", "z"), g)), 2)
+
+
+@pytest.mark.parametrize("region", [{"x": (0, 4)}, {"x": (28, 32)},
+                                    {"y": (0, 8)}, {"y": (40, 48)}],
+                         ids=["x-lo", "x-hi", "y-lo", "y-hi"])
+def test_region_shell_slab_pipe_out_bit_equal(env, region):
+    # the exact shape the overlap scheduler builds: one thin slab per
+    # split-dim boundary (width hK = r·K = 4, y slabs 8-aligned), with
+    # the output pipeline staging through parity tiles
+    from yask_tpu.ops.pallas_stencil import build_pallas_chunk
+    g = (32, 48, 16)
+    ctx = _mk_single(env, g=g)
+    prog = ctx._program
+    blk = (8, 16)
+    full, _ = build_pallas_chunk(prog, fuse_steps=2, block=blk,
+                                 interpret=True, pipeline_dmas=True)
+    slab, _ = build_pallas_chunk(prog, fuse_steps=2, block=blk,
+                                 interpret=True, pipeline_dmas=True,
+                                 region=region)
+    assert slab.tiling["pipeline_out"] is True
+    st = {k: list(v) for k, v in ctx._state.items()}
+    _region_bit_equal(prog, full(st, 0), slab(st, 0), region,
+                      dict(zip(("x", "y", "z"), g)), 2)
+
+
+def test_region_pipe_arms_bit_equal(env):
+    """The output pipeline must never change values: the same region
+    build with the pipeline off agrees to the last bit."""
+    from yask_tpu.ops.pallas_stencil import build_pallas_chunk
+    g = (32, 48, 16)
+    ctx = _mk_single(env, g=g)
+    prog = ctx._program
+    region = {"x": (8, 24)}
+    kw = dict(fuse_steps=2, block=(8, 16), interpret=True, region=region)
+    on, _ = build_pallas_chunk(prog, pipeline_dmas=True, **kw)
+    off, _ = build_pallas_chunk(prog, pipeline_dmas=False, **kw)
+    assert on.tiling["pipeline_out"] is True
+    assert off.tiling["pipeline_out"] is False
+    st = {k: list(v) for k, v in ctx._state.items()}
+    _region_bit_equal(prog, on(st, 0), off(st, 0), region,
+                      dict(zip(("x", "y", "z"), g)), 2)
+
+
+def test_region_sublane_misaligned_lo_raises(env):
+    # y is the sublane axis: a region lo that is not an 8-multiple would
+    # be an unaligned Mosaic output window — the planner must refuse
+    from yask_tpu.ops.pallas_stencil import build_pallas_chunk
+    ctx = _mk_single(env)
+    with pytest.raises(YaskException, match="align"):
+        build_pallas_chunk(ctx._program, fuse_steps=2, block=(8, 16),
+                           interpret=True, region={"y": (4, 20)})

@@ -187,3 +187,92 @@ def test_apply_best_legacy_keys_leave_budget_alone():
     t.apply_best()
     assert t.ctx._opts.wf_steps == 2
     assert t.ctx._opts.vmem_budget_mb == 0
+
+# ---------------------------------------- ladder plan-signature dedupe
+
+def test_dedup_ladder_aliases_identical_plans():
+    """Two rungs whose plan signatures agree share one measurement."""
+    t = _tuner()
+    t._plan_signature = lambda k, blk, mb: '{"block": [8, 16]}'
+    k1 = (2, (8, 16), 64)
+    k2 = (2, (8, 16), 96)
+    assert t._dedup_ladder_key(2, (8, 16), 64, k1) is False  # first seen
+    t.results[k1] = 0.5
+    assert t._dedup_ladder_key(2, (8, 16), 96, k2) is True
+    assert t.results[k2] == 0.5
+    assert t.ladder_dedup_hits == 1
+    assert any("plans identically" in m for m in t.ctx._env.msgs)
+
+
+def test_dedup_ladder_distinct_plans_not_aliased():
+    t = _tuner()
+    t._plan_signature = lambda k, blk, mb: f'{{"limit": {mb}}}'
+    t.results[(2, (8, 16), 64)] = 0.5
+    t._dedup_ladder_key(2, (8, 16), 64, (2, (8, 16), 64))
+    assert t._dedup_ladder_key(2, (8, 16), 96,
+                               (2, (8, 16), 96)) is False
+    assert (2, (8, 16), 96) not in t.results
+    assert t.ladder_dedup_hits == 0
+
+
+def test_dedup_ladder_no_signature_no_dedupe():
+    """A failed plan (signature None) must never alias anything."""
+    t = _tuner()
+    t._plan_signature = lambda k, blk, mb: None
+    t.results[(2, (8, 16), 64)] = 0.5
+    assert t._dedup_ladder_key(2, (8, 16), 96,
+                               (2, (8, 16), 96)) is False
+    assert t.ladder_dedup_hits == 0
+
+
+def test_dedup_ladder_existing_key_untouched():
+    """A key that already has a measurement is never overwritten."""
+    t = _tuner()
+    t._plan_signature = lambda k, blk, mb: '{"same": 1}'
+    t.results[(2, (8, 16), 64)] = 0.5
+    t.results[(2, (8, 16), 96)] = 0.7
+    assert t._dedup_ladder_key(2, (8, 16), 96,
+                               (2, (8, 16), 96)) is False
+    assert t.results[(2, (8, 16), 96)] == 0.7
+
+
+# --------------------------------------------- trapezoid A/B arm keys
+
+def test_apply_best_trap_key_wins():
+    """A winning ("trap", k, blk, mb, flag) arm pins K/block/budget AND
+    the trapezoid knob."""
+    t = _tuner()
+    t.ctx._opts.trapezoid_tiling = False
+    t.results = {(2, (8, 16), 96): 0.5,
+                 ("trap", 4, (8, 32), 64, True): 0.2,
+                 ("trap", 4, (8, 32), 64, False): 0.3}
+    t.apply_best()
+    assert t.ctx._opts.wf_steps == 4
+    assert t.ctx._opts.block_sizes == {"x": 8, "y": 32}
+    assert t.ctx._opts.vmem_budget_mb == 64
+    assert t.ctx._opts.trapezoid_tiling is True
+
+
+def test_apply_best_plain_key_pins_faster_trap_arm():
+    """When a plain walk key wins on raw rate, the A/B still decides the
+    trapezoid knob for replays at that K."""
+    t = _tuner()
+    t.ctx._opts.trapezoid_tiling = True
+    t.results = {(2, (8, 16), 96): 0.1,
+                 ("trap", 2, (8, 16), 96, True): 0.4,
+                 ("trap", 2, (8, 16), 96, False): 0.3}
+    t.apply_best()
+    assert t.ctx._opts.wf_steps == 2
+    assert t.ctx._opts.vmem_budget_mb == 96
+    assert t.ctx._opts.trapezoid_tiling is False   # off arm was faster
+
+
+def test_apply_best_trap_keys_without_knob_attr():
+    """Stub contexts without the trapezoid knob stay untouched (the
+    hasattr guard)."""
+    t = _tuner()
+    assert not hasattr(t.ctx._opts, "trapezoid_tiling")
+    t.results = {("trap", 2, (8, 16), 96, True): 0.1}
+    t.apply_best()
+    assert t.ctx._opts.wf_steps == 2
+    assert not hasattr(t.ctx._opts, "trapezoid_tiling")

@@ -8,6 +8,8 @@ of ``bench.py``:
   fused K=4 over K=1);
 * ssg staggered elastic (multi-var);
 * iso3dfd in bf16 on the validated pallas path (HBM roofline lever);
+* iso3dfd small-radius trapezoid-vs-skew A/B (the two-phase
+  parallel-grid tiling, correctness-gated, TPU-scoped sentinel floor);
 * awp, domain-decomposed with measured halo fraction (multi-device).
 
 Every section is independent (a failure emits an error line and the
@@ -228,6 +230,7 @@ def run_suite(fac, env, budget_secs=None):
         for t in ctx._pallas_tiling.values():
             if t:
                 return {k: t[k] for k in ("skew", "skew_dims",
+                                          "trapezoid", "trap_dims",
                                           "pipeline_dmas",
                                           "pipeline_out",
                                           "overlap_exchange",
@@ -291,6 +294,59 @@ def run_suite(fac, env, budget_secs=None):
              skew1d_gpts=round(r1, 4), skew2d_gpts=round(r2, 4),
              **_tiling_of(c2))
         del c1, c2
+
+    def iso3dfd_trapezoid():
+        # Trapezoid-vs-skew A/B at the config the profit gate engages
+        # on (small radius, K=4 — see docs/performance.md gate table):
+        # -trapezoid arms the gate (pads sized at prepare), the off arm
+        # is the same config on the skew/uniform path.  The correctness
+        # gate asserts BIT-equality against the uniform pallas schedule
+        # (same contract as pipeline_ab: a tiling variant reorders the
+        # sweep, never the per-cell arithmetic — jit is the wrong oracle
+        # here since XLA's fusion reassociates and drifts ~1e-3 after a
+        # few steps regardless of tiling).  The provisional 0.9
+        # TRAP_SPEEDUP_FLOOR is TPU-scoped (the CPU proxy has no
+        # megacore and serializes the diamond fill passes, so its ratio
+        # sits below 1 by construction); the row's tiling block says
+        # whether the gate actually engaged.
+        # 64 is the smallest cube where the gate engages trapezoid for
+        # this stencil (at 48 the planner's 16^2 blocks keep skew ahead;
+        # at 64..384 trapezoid wins the cost model — see the probe table
+        # in docs/performance.md).
+        g = 384 if on_tpu else 64
+        ref = build(fac, env, "iso3dfd", 2, 24, "pallas", wf=4)
+        ref.run_solution(0, 3)
+        chk = build(fac, env, "iso3dfd", 2, 24, "pallas", wf=4,
+                    extra_opts="-trapezoid")
+        chk.run_solution(0, 3)
+        bad = chk.compare_data(ref, epsilon=0.0, abs_epsilon=0.0)
+        if bad:
+            raise RuntimeError(
+                f"trapezoid K=4 not bit-equal to uniform pallas: {bad}")
+        del ref, chk
+        c_off = build(fac, env, "iso3dfd", 2, g, "pallas", wf=4)
+        r_off = measure(c_off, g ** 3, steps)
+        c_on = build(fac, env, "iso3dfd", 2, g, "pallas", wf=4,
+                     extra_opts="-trapezoid")
+        r_on = measure(c_on, g ** 3, steps)
+        if not _tiling_of(c_on).get("trapezoid"):
+            # both arms ran the same plan — a vacuous A/B must error
+            # loudly, not bank a noise ratio as "trap-speedup" (the
+            # tiling only materializes at first chunk build, hence the
+            # post-measure check)
+            raise RuntimeError(
+                f"trapezoid gate did not engage at {g}^3: "
+                f"{_tiling_of(c_on)}")
+
+        def remeasure_ratio():
+            return (measure(c_on, g ** 3, steps)
+                    / max(measure(c_off, g ** 3, steps), 1e-12))
+
+        emit(f"iso3dfd r=2 {g}^3 {plat} trap-speedup",
+             r_on / max(r_off, 1e-12), "x", remeasure=remeasure_ratio,
+             base_gpts=round(r_off, 4), trap_gpts=round(r_on, 4),
+             base_tiling=_tiling_of(c_off), **_tiling_of(c_on))
+        del c_on, c_off
 
     def ssg_elastic():
         gs = 256 if on_tpu else 32
@@ -377,6 +433,7 @@ def run_suite(fac, env, budget_secs=None):
     section(iso3dfd_pallas, t0, budget_secs)
     section(cube_wavefront, t0, budget_secs)
     section(iso3dfd_skew2d, t0, budget_secs)
+    section(iso3dfd_trapezoid, t0, budget_secs)
     section(ssg_elastic, t0, budget_secs)
     section(iso3dfd_bf16, t0, budget_secs)
     section(awp_decomposed, t0, budget_secs)

@@ -342,7 +342,8 @@ def main(argv=None) -> int:
         failure planning the flagship chunk must not cost the
         session's headline hardware number (round-3 failure mode)."""
         ab_cases = ["pipeline_ab", "skew_ab.K2", "skew_ab.K4",
-                    "vmem_ladder", "esk_ab", "bf16_ab", "overlap_ab"]
+                    "vmem_ladder", "esk_ab", "trapezoid_ab", "bf16_ab",
+                    "overlap_ab"]
         if not runner.pending("chunk_abs", ab_cases):
             log("chunk_abs", skipped="all cases journaled complete")
             return
@@ -401,6 +402,7 @@ def main(argv=None) -> int:
             prog_ = prog_ or prog
             state_ = state_ if state_ is not None else state
             vb = kw.pop("vmem_budget", budget)
+            time_chunk.gpts = None   # last successful rate, for ratio rows
             try:
                 chunk, tb = build_pallas_chunk(prog_, interpret=interp,
                                                vmem_budget=vb, **kw)
@@ -433,6 +435,7 @@ def main(argv=None) -> int:
                 if not sanity["ok"]:
                     case_anomalies.extend(sanity["anomalies"])
                     return None
+                time_chunk.gpts = gpts
                 return st1
             except Exception as e:  # noqa: BLE001
                 log(tag, error=str(e)[:300], **kw)
@@ -527,6 +530,51 @@ def main(argv=None) -> int:
             if uni_c is not None and skw_c is not None:
                 log("esk_ab", fuse_steps=4,
                     max_abs_diff=float(max_abs_diff(uni_c, skw_c)))
+            return case_outcome()
+
+        def trapezoid_case():
+            # 3a4) trapezoid/diamond two-phase A/B: first hardware
+            #      execution of the parallel-grid claim (both phases run
+            #      with every grid dim "parallel" — the megacore
+            #      partitioning the cost model credits).  The forced
+            #      trapezoid arm must be BIT-equal to the uniform arm
+            #      (same contract as the bench_suite gate: a tiling
+            #      variant reorders the sweep, never the per-cell
+            #      arithmetic); the speedup row feeds the TPU-scoped
+            #      trap-speedup sentinel floor.  r=2 K=4 is the gate's
+            #      engagement regime (small radius, deep fusion).
+            from yask_tpu.ops.pallas_stencil import trapezoid_pad_need
+            gq = min(gi, 128)
+            pad = trapezoid_pad_need(np.float32, 2, 4)
+            progt = create_solution("iso3dfd", radius=2).get_soln() \
+                .compile().plan(
+                    IdxTuple(x=gq, y=gq, z=gq),
+                    extra_pad={"x": (pad, pad), "y": (pad, pad),
+                               "z": (0, 0)})
+            statet = progt.alloc_state(init=seeded_init(progt))
+            uni_t = time_chunk(
+                "trapezoid_ab", prog_=progt, state_=statet, npts=gq ** 3,
+                metric=(f"iso3dfd r=2 {gq}^3 fp32 tpu pallas chunk "
+                        f"(trapezoid_ab uniform)"),
+                fuse_steps=4, skew=False)
+            g_off = time_chunk.gpts
+            trp = time_chunk(
+                "trapezoid_ab", prog_=progt, state_=statet, npts=gq ** 3,
+                metric=(f"iso3dfd r=2 {gq}^3 fp32 tpu pallas chunk "
+                        f"(trapezoid_ab trap)"),
+                fuse_steps=4, trapezoid=True)
+            g_on = time_chunk.gpts
+            if uni_t is not None and trp is not None:
+                mad = float(max_abs_diff(uni_t, trp))
+                log("trapezoid_ab", fuse_steps=4, max_abs_diff=mad)
+                if should_bank and g_off and g_on:
+                    record({"metric": (f"iso3dfd r=2 {gq}^3 {plat} "
+                                       f"trap-speedup"),
+                            "value": round(g_on / g_off, 4), "unit": "x",
+                            "platform": plat, "uniform_gpts": g_off,
+                            "trap_gpts": g_on, "max_abs_diff": mad})
+                if mad != 0.0:
+                    case_anomalies.append(f"trapezoid-mismatch:{mad}")
             return case_outcome()
 
         def bf16_case():
@@ -637,6 +685,7 @@ def main(argv=None) -> int:
             runner.run_case("chunk_abs", f"skew_ab.K{k}", skew_case(k))
         runner.run_case("chunk_abs", "vmem_ladder", vmem_ladder_case)
         runner.run_case("chunk_abs", "esk_ab", esk_case)
+        runner.run_case("chunk_abs", "trapezoid_ab", trapezoid_case)
         runner.run_case("chunk_abs", "bf16_ab", bf16_case)
         runner.run_case("chunk_abs", "overlap_ab", overlap_ab_case)
 

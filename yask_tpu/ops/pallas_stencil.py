@@ -430,21 +430,42 @@ def skew_plan_hints(program, fuse_steps: int, engaged=None):
         return None, None
     rad = ana.fused_step_radius()
     e_sk = skew_extra_widths(program, fuse_steps)
-    ring_reads = set()
-    for sr_ in program.stage_reads:
-        ring_reads.update(sr_.keys())
-    cv_d = max((len(program_state_slots(program, n))
-                for n, g in program.geoms.items()
-                if g.is_written and not g.is_scratch
-                and n in ring_reads), default=0)
-    smin = ({d: (cv_d + 1) * rad.get(d, 0) for d in engaged}
-            if cv_d else None)
-    smarg = {d: (fuse_steps + 1) * rad.get(d, 0)
-             + e_sk.get(d, skew_extra_width(program.dtype,
-                                            rad.get(d, 0))
-                        if d == lead[-1] else 0)
-             for d in engaged}
-    return smin, smarg
+    # the TilePlan is THE margin-math source: hints are read off the
+    # dataflow plan rather than recomputed here
+    from yask_tpu.ops.tile_planner import TilePlan
+    e_full = {d: e_sk.get(d, skew_extra_width(program.dtype,
+                                              rad.get(d, 0))
+                          if d == lead[-1] else 0)
+              for d in engaged}
+    tp = TilePlan(program, fuse_steps, skew_dims=engaged, e_sk=e_full)
+    return tp.min_block(), tp.margin_override()
+
+
+def trapezoid_eligible_dims(program, fuse_steps: int) -> List[str]:
+    """The lead dims the two-phase trapezoid/diamond tiling CAN run on
+    (lead order), feasibility only.  The geometric constraints are the
+    skew set's (K ≥ 2, radius > 0, full-dim written vars, the two
+    innermost grid dims): phase-1 upright trapezoids reuse the uniform
+    region machinery with one-step margins, and the diamond fill pass
+    reuses it with uniform margins, so anything the skew carries could
+    tile, independent trapezoids can too.  Distribution and region
+    restrictions are rejected by the build (the fill pass assumes the
+    full span of a single device)."""
+    return skew_eligible_dims(program, fuse_steps)
+
+
+def trapezoid_pad_need(dtype, rd: int, k: int) -> int:
+    """Per-side lead-dim pad the two-phase trapezoid tiling needs at
+    fuse depth ``k`` (single definition — the runtime's pad planning
+    and the build agree): the diamond fill tile reaches ``cl(K) + K·r``
+    past each phase-1 tile boundary (half-band + uniform telescoping
+    margin) plus one sublane tile of DMA slab rounding."""
+    if rd <= 0 or k < 2:
+        return rd * max(k, 1)
+    from yask_tpu.compiler.lowering import tpu_tile_dims
+    sub_t, _ = tpu_tile_dims(dtype)
+    cl = -(-((k - 1) * rd) // sub_t) * sub_t
+    return k * rd + cl + 2 * sub_t
 
 
 def default_vmem_budget(platform: str) -> int:
@@ -481,7 +502,9 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                        max_skew_dims: int = 2,
                        plan_only: bool = False,
                        reasons: Optional[List[dict]] = None,
-                       region: Optional[Dict[str, Tuple[int, int]]] = None):
+                       region: Optional[Dict[str, Tuple[int, int]]] = None,
+                       trapezoid=False,
+                       _diamond: Optional[dict] = None):
     """Build ``chunk(state, t0) -> state`` advancing ``fuse_steps`` steps
     in one fused Pallas sweep.
 
@@ -547,6 +570,27 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     ``sub_t``-aligned ``lo`` (output DMA windows keep 8-aligned
     offsets on real Mosaic — raises otherwise), and restricted dims
     never skew (their carry geometry assumes the full span).
+
+    ``trapezoid`` selects the two-phase trapezoid/diamond temporal
+    tiling (the reference's trapezoidal blocking, ``setup.cpp:863``,
+    recast for a parallel Pallas grid): phase 1 decomposes each
+    K-group along the selected dims into carry-free upright trapezoids
+    (one-step fetch margins; level ``lvl``'s write window shrinks by
+    (lvl−1)·r per side) that are mutually independent — so those grid
+    dims are declared ``"parallel"`` instead of ``"arbitrary"`` — and
+    phase 2 fills the inter-tile gap bands with inverted trapezoids
+    (diamonds) centered on every tile boundary, recomputed from the
+    level-0 input state (no carries, any ring depth / stage count).
+    ``False`` = off (the default), ``None`` = auto via the TilePlan
+    profit gate (trapezoid vs skew vs uniform volumes), ``True`` =
+    force the eligible window dims, a list = force exactly those.
+    Trapezoid and skew are mutually exclusive (carries impose the
+    sequential grid the trapezoid exists to remove); engaged trapezoid
+    also disables both DMA pipelines (the linear-index prefetch
+    assumes sequential order).  Single-device, unrestricted builds
+    only.  ``_diamond`` is the internal fill-pass parametrization (the
+    build recurses once per trapezoid dim); its chunk returns raw
+    per-boundary band arrays the outer chunk stitches host-side.
     """
     import jax
     import jax.numpy as jnp
@@ -672,6 +716,118 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # direct caller could combine them; removing them from the eligible
     # set makes forced skew on a restricted dim raise below.)
     unsharded_dims -= restricted
+
+    # ---- trapezoid/diamond resolution ----------------------------------
+    # Resolved BEFORE skew: engaged trapezoid excludes the carries (the
+    # parallel grid has no sequential order for them to ride).  Every
+    # decision is a TilePlan comparison — there is no second
+    # margin-math path.
+    from yask_tpu.ops.tile_planner import TilePlan
+    trap_dims: List[str] = []
+    trap_forced = (trapezoid is True
+                   or (isinstance(trapezoid, (list, tuple, set,
+                                              frozenset)) and trapezoid))
+    if _diamond is not None:
+        trapezoid = False
+    elig_trap = ([] if (distributed or restricted or _diamond is not None)
+                 else trapezoid_eligible_dims(program, K))
+    if isinstance(trapezoid, (list, tuple, set, frozenset)) \
+            and not trapezoid:
+        trapezoid = False
+    if trapezoid is not False and trapezoid is not None:
+        # forced: True = the eligible window dims; a list = exactly those
+        want_t = (list(elig_trap) if trapezoid is True
+                  else [d for d in lead if d in set(trapezoid)])
+        bad_t = [d for d in want_t if d not in elig_trap]
+        if trapezoid is not True and len(want_t) != len(set(trapezoid)):
+            bad_t += sorted(set(trapezoid) - set(want_t))
+        if bad_t or not want_t:
+            raise YaskException(
+                f"trapezoid tiling needs K >= 2, a single-device "
+                f"unrestricted build, radius > 0 in each dim (only "
+                f"lead[-2:] can tile), and all written vars spanning "
+                f"every domain dim; got K={K}, "
+                f"requested={want_t or trapezoid}, eligible={elig_trap}, "
+                f"distributed={distributed}, "
+                f"restricted={sorted(restricted)}")
+        trap_dims = want_t
+        reasons.append({"code": "trapezoid_forced",
+                        "dims": list(trap_dims)})
+    elif trapezoid is None and elig_trap:
+        # auto: TilePlan volume gate — trapezoid vs skew vs uniform, each
+        # variant costed at ITS OWN planned block (trapezoid's 2r fetch
+        # margins admit larger tiles than uniform's 2Kr at high K) and
+        # normalized per useful cell (compute credited with the
+        # parallel-grid cores, fetch not; hardware A/B rows arbitrate)
+        from yask_tpu.ops.tile_planner import plan_blocks as _pb
+        skw_alt = skew_engaged_dims(program, K, unsharded=unsharded_dims,
+                                    max_dims=max_skew_dims)
+
+        def _plan_cost(tp):
+            try:
+                blk = _pb(program, fuse_steps=K, vmem_budget=vmem_budget,
+                          vinstr_cap=vinstr_cap,
+                          min_block=tp.min_block(),
+                          margin_override=tp.margin_override())
+            except YaskException:
+                return float("inf")
+            # a floor the planner could not honor (vinstr cap, domain
+            # size) means the variant cannot actually build — the gate
+            # must agree with the build's feasibility check
+            for d, mn in (tp.min_block() or {}).items():
+                if blk.get(d, 0) < mn:
+                    return float("inf")
+            u, comp, fetch = tp.volumes(blk)
+            cores = TilePlan.PARALLEL_CORES if tp.trap_dims else 1
+            return (comp / cores + fetch) / max(u, 1)
+
+        cost_uni = _plan_cost(TilePlan(program, K))
+        cost_skw = (_plan_cost(TilePlan(program, K, skew_dims=skw_alt,
+                                        e_sk=E_all))
+                    if skw_alt else float("inf"))
+        cost_trp = _plan_cost(TilePlan(program, K, trap_dims=elig_trap))
+        alt = min(cost_uni, cost_skw)
+        gate_det = (f"trap {cost_trp:.2f} vs uniform {cost_uni:.2f}, "
+                    f"skew {cost_skw:.2f} (cells/useful cell, compute/"
+                    f"{TilePlan.PARALLEL_CORES} + fetch, per-variant "
+                    f"planned blocks)")
+        if cost_trp < alt:
+            trap_dims = list(elig_trap)
+            for d in trap_dims:
+                reasons.append({"code": "trapezoid_engaged", "dim": d,
+                                "detail": gate_det})
+        else:
+            for d in elig_trap:
+                reasons.append({"code": "trapezoid_gate_rejected",
+                                "dim": d, "detail": gate_det})
+    elif trapezoid is None:
+        for d in lead:
+            why = ("mesh-decomposed or region-restricted build"
+                   if (distributed or restricted) else
+                   "ineligible (K<2, radius 0, or partial-dim "
+                   "written vars)")
+            reasons.append({"code": "trapezoid_ineligible", "dim": d,
+                            "detail": why})
+    trap_set = set(trap_dims)
+    skew_req = skew
+    if trap_dims:
+        skew = False   # parallel grid: no sequential order for carries
+
+    def _trap_fallback(cause: str):
+        """Auto-engaged trapezoid that turned out infeasible falls back
+        to the skew/uniform resolution the caller asked for."""
+        reasons.append({"code": "trapezoid_fallback", "cause": cause,
+                        "from_dims": list(trap_dims)})
+        return build_pallas_chunk(
+            program, fuse_steps=fuse_steps, block=block_arg,
+            interpret=interpret, vmem_budget=vmem_budget,
+            distributed=distributed, pipeline_dmas=pipeline_dmas,
+            skew=skew_req, vinstr_cap=vinstr_cap,
+            stream_unsharded=stream_unsharded,
+            unsharded_dims=unsharded_dims,
+            max_skew_dims=max_skew_dims, plan_only=plan_only,
+            reasons=reasons, region=region or None, trapezoid=False)
+
     if isinstance(skew, (list, tuple, set, frozenset)) and not skew:
         skew = False   # an explicit empty dim list = uniform shrink
     forced = skew is True or isinstance(skew, (list, tuple, set,
@@ -739,7 +895,9 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         reasons.append({"code": "skew_forced", "dims": list(skew_dims)})
     else:
         reasons.append({"code": "skew_disabled",
-                        "detail": "skew=False requested"})
+                        "detail": ("trapezoid engaged (parallel grid "
+                                   "excludes carries)" if trap_dims
+                                   else "skew=False requested")})
     R = dict(rad)
     # Misaligned (non-sublane-multiple) stream radii: every skewed
     # region carries E_sk extra computed width on its right so the
@@ -749,14 +907,14 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     E = {d: (E_all.get(d, skew_extra_width(program.dtype, R.get(d, 0))
              if d == sdim else 0) if d in skew_set else 0)
          for d in lead}
-    # per-dim tile margins: uniform shrink = radius×K both sides; a
-    # skewed dim keeps K·r on the left (the write regions shift left by
-    # r per sub-step) but only r (+E_sk) on the right
-    mL = {d: hK[d] for d in lead}
-    mR = {d: hK[d] for d in lead}
-    for d in skew_dims:
-        mL[d] = K * R[d]
-        mR[d] = R[d] + E[d]
+    # per-dim tile margins from THE dataflow plan: uniform shrink =
+    # radius×K both sides; a skewed dim keeps K·r on the left (write
+    # regions shift left by r per sub-step) but only r (+E_sk) on the
+    # right; a trapezoid dim reads one step radius per side (the
+    # per-level shrink happens in the write windows)
+    tplan = TilePlan(program, K, skew_dims=skew_dims,
+                     trap_dims=trap_dims, e_sk=E)
+    mL, mR = tplan.margins()
 
     # Every var's leading-dim pads must cover the fused halo, or the DMA
     # start/end would clamp silently and corrupt results: the runtime
@@ -779,13 +937,13 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     explicit_block = block is not None
     if block is None:
         from yask_tpu.ops.tile_planner import plan_blocks
-        # per-dim carry floor + skewed margin model, shared with the
-        # auto-tuner's seed plan (skew_plan_hints)
-        smin, smarg = (skew_plan_hints(program, K, engaged=skew_dims)
-                       if use_skew else (None, None))
+        # per-dim floors (skew carry, trapezoid band) + engaged-dim
+        # margin models, all read off THE TilePlan (the auto-tuner's
+        # seed plan reads the same object via skew_plan_hints)
         block = plan_blocks(program, fuse_steps=K, vmem_budget=vmem_budget,
-                            vinstr_cap=vinstr_cap, min_block=smin,
-                            margin_override=smarg)
+                            vinstr_cap=vinstr_cap,
+                            min_block=tplan.min_block(),
+                            margin_override=tplan.margin_override())
     else:
         block = {d: min(b, span[d]) for d, b in zip(lead, block)}
 
@@ -810,18 +968,31 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     non_scratch_geoms = [g for g in program.geoms.values()
                          if not g.is_scratch]
 
+    # In the diamond fill pass one dim's grid walks tile BOUNDARIES:
+    # its tiles are band-wide (block = 2·half) but advance by the
+    # phase-1 block (stride), centered on each boundary j·stride.
+    dd = _diamond["dim"] if _diamond else None
+
+    def _goff(d):
+        """Interior-coordinate offset of tile position 0 relative to
+        pid·stride (diamond tiles center on the boundary)."""
+        return reg_lo[d] - mL[d] - (_diamond["half"] if d == dd else 0)
+
     def _gcount(d, b):
         """Grid extent in dim d: ceil coverage of the (possibly
         region-restricted) span; each skewed dim needs (K−1)·r more
         tiles on the right because the final-level write regions sit
-        shifted left by (K−1)·r (skew and region are disjoint)."""
+        shifted left by (K−1)·r (skew and region are disjoint); the
+        diamond dim visits every tile boundary, edges included."""
+        if d == dd:
+            return _diamond["nbounds"]
         sp = span[d] + ((K - 1) * R[d] if d in skew_set else 0)
         return -(-sp // b)
 
     def _slab_geom(g, d, b):
         """(base, resid, slab_size) of dim-d windows for var g at block
         size b (window origins shift by the region's lower bound)."""
-        s = g.origin[d] + reg_lo[d] - mL[d]
+        s = g.origin[d] + _goff(d)
         if _sub_dim(g) == d:
             base = (s // sub_t) * sub_t
             r = s - base
@@ -834,17 +1005,27 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         """Ceil-coverage grids let the right-edge window run into the
         right pad; every var's allocation must contain it."""
         gcount = _gcount(d, b)
+        st = _diamond["stride"] if d == dd else b
         for g in non_scratch_geoms:
             if d not in g.domain_dims:
                 continue
-            if g.origin[d] + reg_lo[d] - mL[d] < 0:
+            if g.origin[d] + _goff(d) < 0:
                 return False
             base, _r, sz = _slab_geom(g, d, b)
-            if (gcount - 1) * b + base + sz > g.shape[g.axis_of(d)]:
+            if (gcount - 1) * st + base + sz > g.shape[g.axis_of(d)]:
                 return False
         return True
 
     def _fit_block(d, b):
+        if d == dd:
+            # the diamond dim's block IS the band width — never fitted;
+            # pads that cannot hold the centered windows fail the build
+            # (the outer trapezoid build falls back)
+            if not _overshoot_ok(d, b):
+                raise YaskException(
+                    f"pallas diamond band in dim '{d}' exceeds the "
+                    "planned pads; re-prepare with trapezoid pad needs")
+            return b
         sub = any(_sub_dim(g) == d for g in non_scratch_geoms)
         step = sub_t if sub else 1
         b = max(step, min(b, span[d]))
@@ -1036,6 +1217,25 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             return _fallback("carry floor (ring+1)*r or carry VMEM "
                              "does not fit")
 
+    # Trapezoid feasibility: the deepest level's write window needs
+    # block > 2·shrink, and the fill pass needs a uniform boundary
+    # stride (plan_blocks always yields divisors; an explicit
+    # non-divisor block cannot center the diamonds).
+    if trap_dims:
+        for d in trap_dims:
+            unit = sub_t if d == lead[-1] else 1
+            floor_b = 2 * tplan.cl(d, K) + unit
+            bad_t = (f"block {block[d]} does not divide span {span[d]} "
+                     f"in '{d}'" if span[d] % block[d] != 0 else
+                     f"block {block[d]} < band floor {floor_b} in '{d}'"
+                     if block[d] < floor_b else None)
+            if bad_t is None:
+                continue
+            if trap_forced:
+                raise YaskException(
+                    f"trapezoid tiling infeasible: {bad_t}")
+            return _trap_fallback(bad_t)
+
     tile_bytes = in_tile_bytes + work_bytes
     if tile_bytes > vmem_budget:
         raise YaskException(
@@ -1054,6 +1254,12 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # or there's only one grid step. Grid dims are declared "arbitrary"
     # (sequential) so the linear-index prefetch is sound.
     _pipe_req = pipeline_dmas
+    _trap_no_pipe = bool(trap_dims) or _diamond is not None
+    if _trap_no_pipe:
+        # the cross-step linear-index prefetch (and the in-flight output
+        # staging) assume the sequential grid order the parallel
+        # trapezoid grid no longer provides
+        pipeline_dmas = False
     if pipeline_dmas is None:
         pipeline_dmas = (total_steps > 1
                          and 2 * in_tile_bytes + work_bytes <= vmem_budget)
@@ -1063,7 +1269,9 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
          "detail": "forced" if _pipe_req else "auto (2*in+work fits)"}
         if use_pipe else
         {"code": "pipe_in_off",
-         "detail": ("pipeline_dmas=False requested" if _pipe_req is False
+         "detail": ("parallel trapezoid grid" if _trap_no_pipe
+                    else "pipeline_dmas=False requested"
+                    if _pipe_req is False
                     else "single grid step" if total_steps <= 1
                     else "2*in+work over VMEM budget")})
     if use_pipe:
@@ -1097,6 +1305,57 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         {"code": "pipe_out_off",
          "detail": ("input pipelining off" if not use_pipe
                     else "staging tiles over VMEM budget")})
+    # Grid semantics: the sequential ("arbitrary") order exists for the
+    # skew carries, the linear-index DMA prefetch, and the in-flight
+    # output staging.  A trapezoid build (and its diamond fill pass)
+    # uses none of them — every grid step fetches, computes, stores and
+    # drains synchronously on disjoint output windows — so ALL grid
+    # dims are declared "parallel" (megacore partitioning; scratch is
+    # per-core).  Recorded in the plan/tiling for the checker and the
+    # equivalence tests; applied to CompilerParams on real Mosaic only.
+    dim_sem = tuple(("parallel" if _trap_no_pipe else "arbitrary")
+                    for _ in lead)
+
+    # ---- diamond fill-pass sub-builds (phase 2) -------------------------
+    # One recursive build per trapezoid dim: the UNIFORM kernel (full
+    # K·r margins in every dim, level-0 input state) with that dim's
+    # grid walking every phase-1 tile BOUNDARY (edges included), its
+    # block the diamond band 2·cl(K), advancing by the phase-1 block
+    # (stride).  Output: per-boundary band arrays the outer chunk
+    # stitches host-side.  With two trapezoid dims each pass keeps
+    # uniform margins in the OTHER dim, so the corner bands are
+    # recomputed identically by both passes (elementwise determinism).
+    dia_subs: List[tuple] = []
+    if trap_dims:
+        try:
+            for d in trap_dims:
+                dia = tplan.diamond(d)
+                nbounds = span[d] // block[d] + 1
+                cls = {lvl: tplan.cl(d, lvl) for lvl in range(1, K + 1)}
+                dblock = tuple(dia["band"] if d2 == d else block[d2]
+                               for d2 in lead)
+                sub = build_pallas_chunk(
+                    program, fuse_steps=K, block=dblock,
+                    interpret=interpret, vmem_budget=vmem_budget,
+                    pipeline_dmas=False, skew=False,
+                    vinstr_cap=vinstr_cap, plan_only=plan_only,
+                    reasons=[],
+                    _diamond={"dim": d, "stride": block[d],
+                              "nbounds": nbounds, "half": dia["half"],
+                              "band": dia["band"], "cls": cls})
+                if not plan_only:
+                    sub = sub[0]   # (chunk, tile_bytes) → the chunk fn
+                dia_subs.append((d, block[d], nbounds, dia["half"],
+                                 cls, sub))
+                reasons.append({"code": "trapezoid_diamond", "dim": d,
+                                "band": dia["band"], "nbounds": nbounds,
+                                "stride": block[d]})
+        except YaskException as e:
+            if trap_forced:
+                raise YaskException(
+                    f"trapezoid tiling infeasible (fill pass): {e}")
+            return _trap_fallback(f"diamond fill pass: {e}")
+
     if plan_only:
         # The checker's window into the REAL planner: everything above
         # ran (skew ladder, slab rounding, budget shrink, pipelining)
@@ -1109,6 +1368,18 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             "total_steps": total_steps,
             "skew": bool(use_skew),
             "skew_dims": list(skew_dims),
+            "trapezoid": bool(trap_dims),
+            "trap_dims": list(trap_dims),
+            "dimension_semantics": list(dim_sem),
+            "diamond": [s[-1] for s in dia_subs],
+            **({"diamond_dim": _diamond["dim"],
+                "stride": _diamond["stride"],
+                "nbounds": _diamond["nbounds"],
+                "half": _diamond["half"],
+                "band": _diamond["band"],
+                "cls": {str(l): v
+                        for l, v in _diamond["cls"].items()}}
+               if _diamond is not None else {}),
             "region": {d: list(region[d]) for d in sorted(restricted)},
             "mL": dict(mL), "mR": dict(mR), "E": dict(E),
             "radius": dict(rad),
@@ -1205,6 +1476,11 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 nback = min(K, slots[name])
                 for s in range(nback):
                     lvl = K - nback + s + 1   # time level this slot holds
+                    if dd is not None and _diamond["cls"][lvl] == 0:
+                        # cl(1)=0: phase 1 wrote this level's full
+                        # blocks valid (zero shrink) — no gap band
+                        oi += 1
+                        continue
                     if use_pipe_out:
                         sref = ostage[oi].at[par]
                         osem = out_sem.at[par, oi]
@@ -1247,6 +1523,34 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                                 g.origin[dn] - sh_al
                                 + coords[lead.index(dn)] * block[dn],
                                 wsz))
+                        elif dn == dd:
+                            # diamond fill: level lvl's gap band,
+                            # centered on the boundary this grid step
+                            # covers, lands in the band output's own
+                            # axis.  half and cl are both sublane-
+                            # aligned on the sublane axis, so offsets
+                            # stay 8-aligned.
+                            clv = _diamond["cls"][lvl]
+                            src_idxs.append(pl.ds(
+                                mL[dn] + resid[name, dn]
+                                + _diamond["half"] - clv, 2 * clv))
+                            dst_idxs.append(pl.ds(
+                                _diamond["half"] - clv, 2 * clv))
+                        elif dn in trap_set:
+                            # upright trapezoid: level lvl's write
+                            # window shrinks by (lvl−1)·r per side,
+                            # rounded DOWN to the sublane tile on the
+                            # sublane axis (the sub-tile smear lands
+                            # inside the diamond band, which the fill
+                            # pass overwrites with valid values)
+                            fl = tplan.write_shrink(dn, lvl)
+                            src_idxs.append(pl.ds(
+                                mL[dn] + resid[name, dn] + fl,
+                                block[dn] - 2 * fl))
+                            dst_idxs.append(pl.ds(
+                                g.origin[dn] + reg_lo[dn]
+                                + coords[lead.index(dn)] * block[dn]
+                                + fl, block[dn] - 2 * fl))
                         else:
                             di = lead.index(dn)
                             src_idxs.append(pl.ds(
@@ -1255,9 +1559,17 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                                 g.origin[dn] + reg_lo[dn]
                                 + coords[di] * block[dn],
                                 block[dn]))
+                    dref = outs[oi]
+                    if dd is not None:
+                        # per-boundary band output: lead axis indexed by
+                        # this grid step's boundary position (a traced
+                        # index — the skew carry's pid[-1] precedent)
+                        dref = dref.at[(coords[lead.index(dd)],)
+                                       + tuple(dst_idxs)]
+                    else:
+                        dref = dref.at[tuple(dst_idxs)]
                     cps.append(pltpu.make_async_copy(
-                        sref.at[tuple(src_idxs)],
-                        outs[oi].at[tuple(dst_idxs)], osem))
+                        sref.at[tuple(src_idxs)], dref, osem))
                     oi += 1
             return cps
 
@@ -1282,9 +1594,12 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                             di = lead.index(dn)
                             # sublane-aligned window; the sub-tile
                             # residual is a static shift the kernel
-                            # applies at read/write time
-                            start = (coords[di] * block[dn]
-                                     + base_off[n, dn])
+                            # applies at read/write time.  The diamond
+                            # dim's band tiles advance by the phase-1
+                            # block (stride), not their own width.
+                            st_ = (_diamond["stride"] if dn == dd
+                                   else block[dn])
+                            start = coords[di] * st_ + base_off[n, dn]
                             idxs.append(pl.ds(start, slab[n, dn]))
                     if use_pipe:
                         dst = scratch[si].at[buf]
@@ -1421,8 +1736,9 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 return padded
             return jnp.where(mask, padded, base)
 
-        ev.gidx_base = {d: pid[lead.index(d)] * block[d]
-                        + reg_lo[d] - mL[d] for d in lead}
+        ev.gidx_base = {d: pid[lead.index(d)]
+                        * (_diamond["stride"] if d == dd else block[d])
+                        + _goff(d) for d in lead}
         if distributed:
             for di, d in enumerate(dims):
                 ev.gidx_base[d] = ev.gidx_base.get(d, 0) + off_ref[di]
@@ -1563,8 +1879,10 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                     # 1-D iota (probed on TPU v5e)
                     gidx = (lax.broadcasted_iota(
                                 jnp.int32, tuple(shape), di)
-                            + lo + pid[di] * block[d]
-                            + reg_lo[d] - mL[d])
+                            + lo + pid[di]
+                            * (_diamond["stride"] if d == dd
+                               else block[d])
+                            + _goff(d))
                     if distributed:
                         gidx = gidx + off_ref[di]
                         bound = gdom[d]
@@ -1720,8 +2038,16 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     out_specs = []
     for name in written:
         g = program.geoms[name]
+        oshape = list(g.shape)
+        if dd is not None and dd in g.domain_dims:
+            # diamond fill: one band per boundary — the dim's axis
+            # narrows to the band, a leading per-boundary axis is
+            # prepended; every other axis keeps the padded extent so
+            # the slab geometry is shared with phase 1
+            oshape[g.axis_of(dd)] = _diamond["band"]
+            oshape = [_diamond["nbounds"]] + oshape
         for _ in range(min(K, slots[name])):
-            out_shapes.append(jax.ShapeDtypeStruct(tuple(g.shape), dtype))
+            out_shapes.append(jax.ShapeDtypeStruct(tuple(oshape), dtype))
             out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
     nout_total = len(out_shapes)
 
@@ -1756,14 +2082,17 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
 
     kwargs = {}
     if not interpret:
-        # sequential grid always: staging the outputs reuses the input
-        # scratch tiles (racy under megacore partitioning), and the
-        # linear-index DMA prefetch additionally requires it. The VMEM
-        # limit is raised above Mosaic's 16 MiB default scope (v5e takes
-        # ≥120 MiB, probed): tiles budget vmem_budget, live SSA values
-        # roughly double it.
+        # Sequential grid for skew/pipelined builds: staging the outputs
+        # reuses the input scratch tiles (racy under megacore
+        # partitioning when steps interleave), and the linear-index DMA
+        # prefetch additionally requires it.  Trapezoid/diamond builds
+        # declare every grid dim "parallel" (dim_sem): no carries, no
+        # prefetch, synchronous per-step drains on disjoint windows.
+        # The VMEM limit is raised above Mosaic's 16 MiB default scope
+        # (v5e takes ≥120 MiB, probed): tiles budget vmem_budget, live
+        # SSA values roughly double it.
         kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",) * len(grid),
+            dimension_semantics=dim_sem,
             vmem_limit_bytes=vmem_limit_bytes(vmem_budget))
 
     call = pl.pallas_call(
@@ -1785,6 +2114,10 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             for a in state[n]:
                 flat.append(a.reshape(1) if a.ndim == 0 else a)
         outs = call(*flat)
+        if _diamond is not None:
+            # fill pass: raw per-boundary band arrays — the outer
+            # trapezoid chunk stitches them host-side
+            return list(outs)
         new_state = dict(state)
         oi = 0
         for name in written:
@@ -1819,6 +2152,50 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             # ring after K steps = surviving (already padded) input slots
             # shifted down, plus the newly produced ones
             new_state[name] = list(state[name][nback:]) + news
+        # ---- diamond fill pass (phase 2): stitch the gap bands ------
+        # Each fill chunk recomputes, from the SAME level-0 input
+        # state, the band around every phase-1 tile boundary where the
+        # shrunken write windows left stale/smeared cells; the bands
+        # overwrite those cells with the oracle values.  Windows clip
+        # to the interior (band cells beyond the other dims' grid
+        # coverage are unwritten; out-of-domain band cells are zero by
+        # the in-kernel mask, and the pad re-zero above already holds).
+        for (d_t, stride, nbounds, half, cls, sub) in dia_subs:
+            bouts = sub(state, t0, offsets)
+            bi = 0
+            for name in written:
+                g = program.geoms[name]
+                ax = g.axis_of(d_t)
+                nback = min(K, slots[name])
+                for s in range(nback):
+                    lvl = K - nback + s + 1
+                    clv = cls[lvl]
+                    bnd = bouts[bi]
+                    bi += 1
+                    if clv == 0:
+                        continue   # phase 1 wrote this level in full
+                    a = new_state[name][slots[name] - nback + s]
+                    for j in range(nbounds):
+                        s_lo = max(0, j * stride - clv)
+                        s_hi = min(sizes[d_t], j * stride + clv)
+                        if s_hi <= s_lo:
+                            continue
+                        didx = [slice(None)] * a.ndim
+                        didx[ax] = slice(g.origin[d_t] + s_lo,
+                                         g.origin[d_t] + s_hi)
+                        sidx = [j] + [slice(None)] * a.ndim
+                        sidx[1 + ax] = slice(half + s_lo - j * stride,
+                                             half + s_hi - j * stride)
+                        for dn2, kind2 in g.axes:
+                            if kind2 != "domain" or dn2 in (minor, d_t):
+                                continue
+                            ax2 = g.axis_of(dn2)
+                            didx[ax2] = slice(g.origin[dn2],
+                                              g.origin[dn2]
+                                              + sizes[dn2])
+                            sidx[1 + ax2] = didx[ax2]
+                        a = a.at[tuple(didx)].set(bnd[tuple(sidx)])
+                    new_state[name][slots[name] - nback + s] = a
         return new_state
 
     # Report the tiling ACTUALLY chosen (skew/pipelining can auto-fall
@@ -1828,25 +2205,37 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # exact per-(sub-step, stage) region widths — the number the skew
     # tiling exists to shrink (reference reports the analogous
     # wave-front overlap in its temporal-tiling stats).
-    _useful = _computed = 0
-    for _k in range(K):
-        _cons = {d: rad[d] * _k for d in lead}
-        for _si in range(nstages):
-            for d in lead:
-                _cons[d] += stage_r[_si][d]
-            _v = _u = 1
-            for d in lead:
-                if d in skew_set:
-                    _cst = _cons[d] - rad[d] * _k
-                    _v *= block[d] + 2 * (R[d] - _cst) + E[d]
-                else:
-                    _v *= block[d] + mL[d] + mR[d] - 2 * _cons[d]
-                _u *= block[d]
-            _computed += _v
-            _useful += _u
+    if trap_dims:
+        # trapezoid: THE dataflow plan's cost model (phase-1 shrinking
+        # regions + the diamond fill-pass recompute) — the same numbers
+        # the profit gate compared
+        _useful, _computed, _f = tplan.volumes(block)
+    else:
+        _useful = _computed = 0
+        for _k in range(K):
+            _cons = {d: rad[d] * _k for d in lead}
+            for _si in range(nstages):
+                for d in lead:
+                    _cons[d] += stage_r[_si][d]
+                _v = _u = 1
+                for d in lead:
+                    if d in skew_set:
+                        _cst = _cons[d] - rad[d] * _k
+                        _v *= block[d] + 2 * (R[d] - _cst) + E[d]
+                    else:
+                        _v *= block[d] + mL[d] + mR[d] - 2 * _cons[d]
+                    _u *= block[d]
+                _computed += _v
+                _useful += _u
     chunk.tiling = {"fuse_steps": K, "block": dict(block),
                     "skew": bool(use_skew),
                     "skew_dims": list(skew_dims),
+                    "trapezoid": bool(trap_dims),
+                    "trap_dims": list(trap_dims),
+                    "dimension_semantics": list(dim_sem),
+                    "diamond": [{"dim": s[0], "stride": s[1],
+                                 "nbounds": s[2], "half": s[3]}
+                                for s in dia_subs],
                     "region": ({d: list(region[d]) for d in sorted(restricted)}
                                if restricted else None),
                     "pipeline_dmas": use_pipe,

@@ -120,8 +120,15 @@ def overlap_decision(ctx, K: int, local_prog=None):
     minor = dims[-1]
     nr = {d: opts.num_ranks[d] for d in dims}
     lsizes = opts.rank_domain_sizes
+    # the core/shell shrink margin comes off THE TilePlan (the single
+    # margin-math source for the fused pallas path); the minor (lane)
+    # dim is never a tiled lead dim, so its ghost width stays the raw
+    # fused halo for the extra-pad map below
+    from yask_tpu.ops.tile_planner import TilePlan
+    tplan = TilePlan(ctx._program, K)
     rad = ana.fused_step_radius()
-    hK = {d: rad.get(d, 0) * K for d in dims}
+    hK = {d: tplan.halo(d) for d in tplan.lead}
+    hK[tplan.minor] = rad.get(tplan.minor, 0) * K
     setting = getattr(opts, "overlap_exchange", "auto")
     reasons: List[dict] = []
 
@@ -800,8 +807,13 @@ def _prep_shard_pallas(ctx, n: int, K: int, blk):
             f"shard_pallas with wf_steps={K} > 1 cannot shard the minor "
             f"dim '{minor}' (its in-tile region never shrinks); use "
             "wf_steps 1 or keep the minor dim whole")
+    # ghost widths off THE TilePlan (single margin-math source; the
+    # minor dim keeps the raw fused halo — it is never a tiled lead dim)
+    from yask_tpu.ops.tile_planner import TilePlan
+    _tplan = TilePlan(ctx._program, K)
     rad = ana.fused_step_radius()
-    hK = {d: rad.get(d, 0) * K for d in dims}
+    hK = {d: _tplan.halo(d) for d in _tplan.lead}
+    hK[_tplan.minor] = rad.get(_tplan.minor, 0) * K
     for d in dims:
         if nr.get(d, 1) > 1 and lsizes[d] < hK[d]:
             raise YaskException(

@@ -110,6 +110,20 @@ SKEW2D_SPEEDUP_FLOOR = 0.75
 #: the ratio is the point of the feature and should clear 1.
 SP_OVERLAP_SPEEDUP_FLOOR = 0.95
 
+#: PROVISIONAL floor for the trapezoid-vs-skew/uniform A/B
+#: (bench_suite ``trap-speedup``: the two-phase trapezoid/diamond
+#: tiling forced via -trapezoid against the same config with the knob
+#: off).  The failure class: the parallel-grid win is megacore
+#: partitioning + the 2r fetch margin, and both evaporate if the
+#: diamond fill passes grow past their model (band recompute is real
+#: work) — a collapse of this ratio means the gate engaged where it
+#: should not.  TPU-scoped: the CPU interpret proxy has no megacore
+#: (cores=2 credit is pure overhead there) and serializes the fill
+#: passes, so the proxy ratio sits below 1 BY CONSTRUCTION and only
+#: the trailing-median backstop guards that arm.  Re-base from clean
+#: TPU rows once tpu_session banks the trapezoid_ab stage.
+TRAP_SPEEDUP_FLOOR = 0.9
+
 DEFAULT_RULES: List[GuardRule] = [
     GuardRule(name="iso3dfd-128-jit-floor",
               pattern="128^3 fp32 cpu throughput",
@@ -124,6 +138,10 @@ DEFAULT_RULES: List[GuardRule] = [
     GuardRule(name="sp-overlap-speedup-floor",
               pattern="sp-overlap-speedup",
               floor=SP_OVERLAP_SPEEDUP_FLOOR, rel_tol=0.25,
+              platforms=("axon", "tpu")),
+    GuardRule(name="trap-speedup-floor",
+              pattern="trap-speedup",
+              floor=TRAP_SPEEDUP_FLOOR, rel_tol=0.25,
               platforms=("axon", "tpu")),
     # the backstop every throughput/speedup row gets: trailing clean
     # median, generous tolerance (CPU-proxy trial noise is real)

@@ -48,6 +48,14 @@ class AutoTuner:
         # must stay loud (round-3 postmortem; hoisted to the shared
         # yask_tpu.resilience.Breaker).
         self._breaker = Breaker(threshold=3)
+        # vmem-ladder plan-signature dedupe: rungs whose planner output
+        # AND scoped Mosaic limit agree compile identical kernels, so
+        # the later rung aliases the earlier rung's measurement instead
+        # of re-compiling + re-timing it (all three default rungs share
+        # vmem_limit 128 MiB, so a plan the budget doesn't pinch repeats
+        # three times without this).
+        self._sig_keys: Dict[str, Tuple] = {}
+        self.ladder_dedup_hits = 0
 
     @property
     def _consec_fails(self) -> int:
@@ -337,6 +345,68 @@ class AutoTuner:
             ctx._env.trace_msg(f"auto-tuner: vmem budget {mb} MiB wins")
         return self._finish_joint(cur, cur_rate, lead)
 
+    def _plan_signature(self, k: int, blk: Tuple, mb: int):
+        """Canonical JSON of the planner's full decision record for
+        ``(K, block, budget)`` plus the scoped Mosaic limit that budget
+        implies.  Two ladder rungs with equal signatures would compile
+        byte-identical kernels — ``plan_only`` is the planner itself, so
+        every block shrink, skew/trapezoid engagement, and pipeline
+        decision is in the dict and the signature cannot drift from the
+        build.  ``reasons`` strings (and the raw budget) are stripped
+        recursively: they mention the rung by name without changing the
+        artifact.  Returns None when planning fails (``_measure``
+        classifies the failure on the real build instead)."""
+        import json
+        ctx = self.ctx
+        from yask_tpu.checker.vmem import plan_pallas
+        from yask_tpu.ops.pallas_stencil import vmem_limit_bytes
+        bs = ctx._opts.block_sizes
+        lead = ctx._ana.domain_dims[:-1]
+        old_b = {d: bs[d] for d in lead}
+        old_k = ctx._opts.wf_steps
+        for d, b in zip(lead, blk):
+            bs[d] = b
+        ctx._opts.wf_steps = k
+        try:
+            plan = plan_pallas(ctx, ctx._program, mb * 2 ** 20)
+        except Exception:  # noqa: BLE001 — infeasible rung, no dedupe
+            return None
+        finally:
+            for d in lead:
+                bs[d] = old_b[d]
+            ctx._opts.wf_steps = old_k
+
+        def strip(o):
+            if isinstance(o, dict):
+                return {kk: strip(v) for kk, v in o.items()
+                        if kk not in ("reasons", "vmem_budget")}
+            if isinstance(o, (list, tuple)):
+                return [strip(x) for x in o]
+            return o
+
+        sig = strip(plan)
+        sig["vmem_limit"] = vmem_limit_bytes(mb * 2 ** 20)
+        return json.dumps(sig, sort_keys=True, default=str)
+
+    def _dedup_ladder_key(self, k: int, blk: Tuple, mb: int,
+                          key: Tuple) -> bool:
+        """Alias ``key``'s result to an earlier rung's measurement when
+        the plan signatures agree.  Returns True on a dedupe hit."""
+        if key in self.results:
+            return False
+        sig = self._plan_signature(k, blk, mb)
+        if sig is None:
+            return False
+        first = self._sig_keys.setdefault(sig, key)
+        if first != key and first in self.results:
+            self.results[key] = self.results[first]
+            self.ladder_dedup_hits += 1
+            self.ctx._env.trace_msg(
+                f"auto-tuner: rung candidate {key} plans identically to "
+                f"{first}; reusing its measurement")
+            return True
+        return False
+
     def _start_point(self, k0):
         """Planner-informed starting (K, blocks) for the joint walk."""
         from yask_tpu.ops.tile_planner import plan_blocks
@@ -417,12 +487,63 @@ class AutoTuner:
                         for d in lead:
                             bs[d] = old[d]
                 key = (k, blk, mb) if ladder else (k, blk)
+                if ladder:
+                    self._dedup_ladder_key(k, blk, mb, key)
                 return self._measure(key, mk, k=k)
 
             return self._walk(measure, k0, self._start_point(k0),
                               sizes, lead, kmax)
 
-        return self._walk_ladder(walk_one, lead)
+        best_k = self._walk_ladder(walk_one, lead)
+        self._trapezoid_ab(best_k)
+        return best_k
+
+    def _trapezoid_ab(self, kw: int) -> None:
+        """Trapezoid on/off as the final axis of the single-device joint
+        walk, A/B'd at the winning (K, blocks, vmem) point — the analog
+        of the shard walk's overlap arm.  Only when the ``-trapezoid``
+        knob is enabled AND the auto gate actually engages it at the
+        winning point (arms that plan identically would time the same
+        kernel twice); the losing arm pins ``trapezoid_tiling`` off so
+        production compiles skip the gate the measurement overruled."""
+        ctx = self.ctx
+        if not getattr(ctx._opts, "trapezoid_tiling", False):
+            return
+        kw = max(kw, 1)
+        lead = ctx._ana.domain_dims[:-1]
+        blkw = tuple(ctx._opts.block_sizes[d] for d in lead)
+        mbw = ctx._opts.vmem_budget_mb
+        try:
+            plan = self._plan_signature(kw, blkw, mbw)
+            import json
+            engaged = (plan is not None
+                       and json.loads(plan).get("trapezoid", False))
+        except Exception:  # noqa: BLE001
+            engaged = False
+        if not engaged:
+            return
+        rates = {}
+        saved = ctx._opts.trapezoid_tiling
+        try:
+            for on in (False, True):
+                ctx._opts.trapezoid_tiling = on
+
+                def mk():
+                    return ctx._get_pallas_chunk(kw)
+
+                rates[on] = self._measure(("trap", kw, blkw, mbw, on),
+                                          mk, k=kw)
+        finally:
+            ctx._opts.trapezoid_tiling = saved
+        r_on = rates.get(True, float("inf"))
+        r_off = rates.get(False, float("inf"))
+        if r_on == float("inf") and r_off == float("inf"):
+            return
+        win = r_on < r_off
+        ctx._opts.trapezoid_tiling = win
+        ctx._env.trace_msg(
+            f"auto-tuner: trapezoid={'on' if win else 'off'} "
+            f"(on {r_on * 1e3:.3f} vs off {r_off * 1e3:.3f} ms/step)")
 
     def _walk_joint_shard(self, candidates=None) -> int:
         """Joint (K, block-shape) walk for the distributed shard_pallas
@@ -573,8 +694,12 @@ class AutoTuner:
         if not feasible:    # nothing measurable — keep current settings
             return
         best = min(feasible, key=feasible.get)
+        trap_flag = None
         if best[0] == "sp":     # shard_pallas joint result
             best = best[1:]
+        elif best[0] == "trap":  # trapezoid A/B arm won outright
+            trap_flag = bool(best[4])
+            best = best[1:4]
         self.ctx._opts.wf_steps = best[0]
         if len(best) > 1:   # joint (k, block-shape) result
             lead = self.ctx._ana.domain_dims[:-1]
@@ -584,6 +709,20 @@ class AutoTuner:
             # vmem-ladder result: pin the winning budget so replays
             # compile with the rung the measurement actually used
             self.ctx._opts.vmem_budget_mb = best[2]
+        if hasattr(self.ctx._opts, "trapezoid_tiling"):
+            if trap_flag is not None:
+                self.ctx._opts.trapezoid_tiling = trap_flag
+            else:
+                # trapezoid A/B arms measured at this K but a plain walk
+                # key won on raw rate — still pin the faster arm so
+                # replays get the tiling the A/B decided on (mirror of
+                # the overlap-arm pinning below)
+                tarms = {kk[4]: v for kk, v in feasible.items()
+                         if len(kk) == 5 and kk[0] == "trap"
+                         and kk[1] == best[0]}
+                if tarms:
+                    self.ctx._opts.trapezoid_tiling = bool(
+                        min(tarms, key=tarms.get))
         if not hasattr(self.ctx._opts, "overlap_exchange"):
             return
         if len(best) > 3 and best[3] is not None:

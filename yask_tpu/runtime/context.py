@@ -631,6 +631,7 @@ class StencilContext:
         sk_dims = ()
         if self._opts.skew_wavefront and self._opts.skew_dims_max > 0:
             sk_dims = lead[-self._opts.skew_dims_max:]
+        tz_dims = lead[-2:] if self._opts.trapezoid_tiling else ()
         needs = {}
         for d in lead:
             rd = step_rad.get(d, 0)
@@ -648,6 +649,15 @@ class StencilContext:
                     from yask_tpu.ops.pallas_stencil import \
                         skew_extra_width
                     need_r += 2 * skew_extra_width(self._csol.dtype, rd)
+            if d in tz_dims and rd > 0:
+                # trapezoid window dims: the diamond fill pass centers
+                # band tiles on the OUTERMOST tile boundaries, so both
+                # sides need the K·r margin + half-band + slab rounding
+                # room (single definition: trapezoid_pad_need)
+                from yask_tpu.ops.pallas_stencil import trapezoid_pad_need
+                tz = trapezoid_pad_need(self._csol.dtype, rd, max(k, 1))
+                need = max(need, tz)
+                need_r = max(need_r, tz)
             needs[d] = (need, need_r)
         return needs
 
@@ -710,7 +720,8 @@ class StencilContext:
         skw = None if o.skew_wavefront else False
         sdm = o.skew_dims_max if o.skew_wavefront else 0
         ovx = getattr(o, "overlap_exchange", "auto")
-        return (skw, sdm, o.vmem_budget_mb, ovx)
+        trz = None if getattr(o, "trapezoid_tiling", False) else False
+        return (skw, sdm, o.vmem_budget_mb, ovx, trz)
 
     def _pallas_build_key(self, K: int):
         """(cache key, block tuple, skew arg) for the configured pallas
@@ -736,7 +747,9 @@ class StencilContext:
                 self._program, fuse_steps=K, block=blk, interpret=interp,
                 vmem_budget=self.vmem_budget(), skew=skw,
                 vinstr_cap=self._opts.max_tile_vinstr,
-                max_skew_dims=self._opts.skew_dims_max)
+                max_skew_dims=self._opts.skew_dims_max,
+                trapezoid=(None if self._opts.trapezoid_tiling
+                           else False))
             self._state_to_device()
             t0c = time.perf_counter()
             if interp:

@@ -79,6 +79,19 @@ class KernelSettings:
         # row; the multi-dim trapezoid analog of the reference's
         # wave-front tiling in multiple dims).
         self.skew_dims_max = 2
+        # Two-phase trapezoid/diamond temporal tiling on the pallas
+        # path (the reference's trapezoidal blocking, setup.cpp:863,
+        # recast for a PARALLEL Pallas grid): phase 1 = carry-free
+        # upright trapezoids whose per-level write windows shrink by r
+        # per side (mutually independent tiles — the grid dims drop the
+        # "arbitrary" sequential constraint), phase 2 = inverted
+        # trapezoids (diamonds) recomputing the inter-tile gap bands
+        # from the level-0 state.  False = off (default; skew remains
+        # the auto tiling), True = auto-engage when the TilePlan profit
+        # gate says the parallel grid pays; mutually exclusive with the
+        # skewed wavefront (carries need a sequential grid).  Pads are
+        # planned with the diamond band room when enabled.
+        self.trapezoid_tiling = False
         # Overlapped halo exchange on the shard_pallas path: split each
         # fused K-group into a core chunk (interior shrunk by radius×K
         # per sharded dim, evaluated against PRE-exchange state so XLA
@@ -178,6 +191,11 @@ class KernelSettings:
             "skew_dims", "Max grid dims the skewed wavefront may "
             "engage (1 = stream dim only, 2 = also the second-inner "
             "dim).", self, "skew_dims_max")
+        parser.add_bool_option(
+            "trapezoid", "Two-phase trapezoid/diamond temporal tiling "
+            "on the pallas path (parallel grid; auto-engaged via the "
+            "TilePlan profit gate when enabled).", self,
+            "trapezoid_tiling")
         parser.add_string_option(
             "overlap_x", "shard_pallas overlapped halo exchange: "
             "auto|on|off (core/shell split of the fused K-group; the "
