@@ -6,8 +6,8 @@ PY ?= python
 TEST_ENV ?= PALLAS_AXON_POOL_IPS=
 
 .PHONY: all native capi test test-fast scratch-tests boundary-tests \
-        stages-tests mode-tests bench perfcheck faultcheck examples \
-        clean list-stencils lint check
+        stages-tests mode-tests bench perfcheck faultcheck commcheck \
+        examples clean list-stencils lint check
 
 all: native test
 
@@ -70,6 +70,14 @@ perfcheck: lint
 faultcheck: lint
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m pytest \
 		tests/test_resilience.py -q
+
+# the communication scheduler end-to-end on the CPU mesh: plan
+# construction, coalescing/order bit-equality, corner composition,
+# measured collective rounds, COMM-* checker rules, multihost launcher
+# (see docs/performance.md "ICI/DCN comm scheduling")
+commcheck: lint
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_comm_schedule.py -q
 
 examples:
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) examples/swe_main.py

@@ -24,7 +24,8 @@ def env():
 
 
 def _build(env, name, radius, mode, wf=1, blk=None, eb=4, ranks=(),
-           overlap=True, ovx=None, trz=None):
+           overlap=True, ovx=None, trz=None, coalesce=None,
+           comm_order=None):
     from yask_tpu.runtime.init_utils import init_solution_vars
     from yask_tpu.compiler.solution_base import create_solution
     fac = yk_factory()
@@ -43,6 +44,10 @@ def _build(env, name, radius, mode, wf=1, blk=None, eb=4, ranks=(),
         s.overlap_exchange = ovx
     if trz is not None:
         s.trapezoid_tiling = trz
+    if coalesce is not None:
+        s.coalesce = coalesce
+    if comm_order is not None:
+        s.comm_order = comm_order
     for d, b in (blk or {}).items():
         ctx.set_block_size(d, b)
     for d, r in ranks:
@@ -56,7 +61,8 @@ _jit_ref_cache = {}
 
 
 def _check(env, name, radius, mode, wf=1, blk=None, eb=4, ranks=(),
-           overlap=True, ovx=None, trz=None):
+           overlap=True, ovx=None, trz=None, coalesce=None,
+           comm_order=None):
     eps = (1e-3, 1e-4) if eb == 4 else (3e-2, 3e-2)
     key = (name, radius, eb)
     if key not in _jit_ref_cache:
@@ -70,7 +76,8 @@ def _check(env, name, radius, mode, wf=1, blk=None, eb=4, ranks=(),
                                     abs_epsilon=eps[1]) == 0
         _jit_ref_cache[key] = ref
     ctx = _build(env, name, radius, mode, wf=wf, blk=blk, eb=eb,
-                 ranks=ranks, overlap=overlap, ovx=ovx, trz=trz)
+                 ranks=ranks, overlap=overlap, ovx=ovx, trz=trz,
+                 coalesce=coalesce, comm_order=comm_order)
     ctx.run_solution(0, 1)
     assert ctx.compare_data(_jit_ref_cache[key], epsilon=eps[0],
                             abs_epsilon=eps[1]) == 0
@@ -138,6 +145,29 @@ def test_matrix_trapezoid(env, trz, name, radius, wf):
     # against the jit twin (the forced-path equivalence lives in
     # tests/test_trapezoid.py)
     _check(env, name, radius, "pallas", wf=wf, trz=trz)
+
+
+@pytest.mark.parametrize("coalesce", ["on", "off"])
+@pytest.mark.parametrize("ranks",
+                         [[("x", 4)], [("x", 2), ("y", 2)],
+                          [("x", 2), ("y", 2), ("z", 2)]],
+                         ids=["x4", "x2y2", "x2y2z2"])
+@pytest.mark.parametrize("mode", ["shard_map", "shard_pallas"])
+def test_matrix_comm_schedule(env, mode, ranks, coalesce):
+    # mesh-shape × coalescing axis: the packed per-(axis,direction)
+    # ppermute schedule across 1-D/2-D/3-D meshes.  shard_pallas keeps
+    # K=1 here (the minor dim is sharded in the 3-D row); the K>1
+    # coalesce arm lives in tests/test_comm_schedule.py
+    _check(env, "iso3dfd", 2, mode, wf=1, ranks=ranks,
+           coalesce=coalesce)
+
+
+def test_matrix_comm_order_permutation(env):
+    # explicit exchange-order permutation must agree with the oracle
+    # like every other row (bit-equality between orders is proved in
+    # tests/test_comm_schedule.py)
+    _check(env, "iso3dfd", 2, "shard_map",
+           ranks=[("x", 2), ("y", 2)], comm_order="y,x")
 
 
 @pytest.mark.parametrize("ovx", ["on", "off", "auto"])

@@ -87,6 +87,35 @@ def test_clean_code_not_flagged(tmp_path):
     assert fs == []
 
 
+def test_mesh_direct_fires_outside_factory(tmp_path):
+    fs = lint_src(tmp_path, """\
+        from jax.sharding import Mesh
+
+        def build(devs):
+            return Mesh(devs, axis_names=("x",))
+    """)
+    assert fired(fs) == ["MESH-DIRECT"]
+
+
+def test_mesh_direct_exempt_in_factory_and_pragma(tmp_path):
+    import os
+    (tmp_path / "yask_tpu" / "parallel").mkdir(parents=True)
+    fs = lint_src(tmp_path, """\
+        from jax.sharding import Mesh
+
+        def make_mesh(devs, axes):
+            return Mesh(devs, axis_names=axes)
+    """, name=os.path.join("yask_tpu", "parallel", "mesh.py"))
+    assert fs == []
+    fs = lint_src(tmp_path, """\
+        import jax.sharding as shd
+
+        def probe(devs):
+            return shd.Mesh(devs, ("x",))  # lint: mesh-ok
+    """)
+    assert fs == []
+
+
 def test_ordinary_eq_in_expr_suffix_name_only(tmp_path):
     # names NOT in the suspect set stay un-flagged
     fs = lint_src(tmp_path, """\

@@ -378,7 +378,7 @@ def test_acceptance_all_zero_output_quarantined(tmp_path):
     # --resume runs exactly one A/B (keeps the CPU-interpret run short)
     j = SessionJournal(env["YT_SESSION_JOURNAL"])
     for c in ("skew_ab.K2", "skew_ab.K4", "vmem_ladder", "esk_ab",
-              "bf16_ab"):
+              "bf16_ab", "comm_ab"):
         j.record("chunk_abs", c, "skip", reason="test pre-seed")
     r = _run_session(env, "-g", "64", "--stages", "chunk_abs",
                      "--resume")

@@ -238,6 +238,17 @@ def run_suite(fac, env, budget_secs=None):
                                           "margin_overhead") if k in t}
         return {}
 
+    def _comm_of(ctx):
+        """Comm-schedule row fields (mesh shape, per-axis kB, collective
+        rounds — measured when halo-cal ran); {} on single-device
+        paths or any plan failure (row fields must not kill a
+        section)."""
+        from yask_tpu.parallel.comm_plan import comm_ledger_fields
+        try:
+            return comm_ledger_fields(ctx)
+        except Exception:
+            return {}
+
     def iso3dfd_pallas():
         validated_pallas(fac, env, "iso3dfd", 8, wf=2)
         g = 512 if on_tpu else 48
@@ -386,8 +397,38 @@ def run_suite(fac, env, budget_secs=None):
         emit(f"awp {ga}^3 {plat} x{ndev} shard_map", rate, "GPts/s",
              remeasure=lambda: measure(ctx, ga ** 3, steps),
              roofline=ctx_roofline(ctx, env, rate),
-             halo_pct=round(halo_pct, 2))
+             halo_pct=round(halo_pct, 2), **_comm_of(ctx))
         del ctx
+
+    def sm_coalesce():
+        # Message-coalescing A/B on a 2-D mesh (the shape where slabs
+        # per axis multiply): one packed ppermute per (axis, direction)
+        # vs one per buffer slab, same geometry — the CommPlan's
+        # headline lever.  Rows carry measured collective counts
+        # (comm_rounds_measured, from the traced exchange twin) so the
+        # ledger shows the round reduction, not just the rate delta.
+        if ndev < 4:
+            return
+        g = 256 if on_tpu else 32
+        c_off = build(fac, env, "ssg", 2, g, "shard_map",
+                      ranks=[("x", 2), ("y", 2)], measure_halo=True,
+                      extra_opts="-coalesce off")
+        r_off = measure(c_off, g ** 3, steps)
+        c_on = build(fac, env, "ssg", 2, g, "shard_map",
+                     ranks=[("x", 2), ("y", 2)], measure_halo=True,
+                     extra_opts="-coalesce on")
+        r_on = measure(c_on, g ** 3, steps)
+
+        def remeasure_ratio():
+            return (measure(c_on, g ** 3, steps)
+                    / max(measure(c_off, g ** 3, steps), 1e-12))
+
+        emit(f"ssg r=2 {g}^3 {plat} x2y2 sm-coalesce-speedup",
+             r_on / max(r_off, 1e-12), "x", remeasure=remeasure_ratio,
+             serial_gpts=round(r_off, 4), coalesced_gpts=round(r_on, 4),
+             serial_rounds=_comm_of(c_off).get("comm_rounds_measured"),
+             **_comm_of(c_on))
+        del c_on, c_off
 
     def sp_overlap():
         # Overlapped halo exchange A/B on the flagship multi-chip path:
@@ -423,7 +464,8 @@ def run_suite(fac, env, budget_secs=None):
              r_on / max(r_off, 1e-12), "x", remeasure=remeasure_ratio,
              serial_gpts=round(r_off, 4), overlap_gpts=round(r_on, 4),
              overlap_eff=round(eff_on, 4),
-             serial_eff=round(eff_off, 4), **_tiling_of(c_on))
+             serial_eff=round(eff_off, 4), **_tiling_of(c_on),
+             **_comm_of(c_on))
         del c_on, c_off
 
     # explicit section(...) calls (not a loop over a tuple): repo_lint's
@@ -437,6 +479,7 @@ def run_suite(fac, env, budget_secs=None):
     section(ssg_elastic, t0, budget_secs)
     section(iso3dfd_bf16, t0, budget_secs)
     section(awp_decomposed, t0, budget_secs)
+    section(sm_coalesce, t0, budget_secs)
     section(sp_overlap, t0, budget_secs)
     return list(ROWS)
 

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Multi-host (multi-process) launch path for the shard modes.
+
+The reference scales across nodes by launching one MPI rank per node
+(``setup.cpp:51-90``); the JAX analog is one *process* per host joined
+into a cluster via ``jax.distributed``, after which ``jax.devices()``
+spans every host and the ONE mesh factory (``parallel.mesh.make_mesh``)
+lays the solution's rank grid over the global device list — ICI within
+a slice, DCN across hosts.  The CommPlan classifies each mesh axis
+(``mesh_axis_kinds``) and orders the DCN axes first, so the launch tool
+only has to build the same solution on every process and run; there is
+no per-axis code here.
+
+Run the SAME command on every host, varying only ``--process_id``::
+
+    python tools/launch_multihost.py \
+        --coordinator host0:8476 --num_processes 2 --process_id 0 \
+        -stencil iso3dfd -radius 8 -g 256 -mode shard_pallas \
+        -ranks x=2,y=2 -steps 32
+
+With ``--num_processes 1`` (the default) no cluster is formed and the
+tool is a single-host driver — the CPU-testable path
+(``tests/test_comm_schedule.py``).
+
+Device work routes through ``guarded_call`` (repo_lint's
+BARE-DEVICE-CALL closure) with fault sites ``multihost.prepare`` /
+``multihost.run`` so the resilience injection harness reaches this
+driver like every other one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from yask_tpu.resilience.faults import fault_point    # noqa: E402
+from yask_tpu.resilience.guard import guarded_call    # noqa: E402
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(
+        description="multi-process shard-mode launcher")
+    ap.add_argument("--coordinator", default="",
+                    help="coordinator address host:port "
+                         "(required when --num_processes > 1)")
+    ap.add_argument("--num_processes", type=int, default=1)
+    ap.add_argument("--process_id", type=int, default=0)
+    ap.add_argument("-stencil", default="iso3dfd")
+    ap.add_argument("-radius", type=int, default=8)
+    ap.add_argument("-g", type=int, default=128,
+                    help="global cube edge")
+    ap.add_argument("-mode", default="shard_map",
+                    choices=["sharded", "shard_map", "shard_pallas"])
+    ap.add_argument("-ranks", default="x=2",
+                    help="mesh axes, e.g. x=2,y=2")
+    ap.add_argument("-steps", type=int, default=8)
+    ap.add_argument("-wf_steps", type=int, default=1)
+    ap.add_argument("-comm_order", default="",
+                    help="explicit exchange order (default: cost model)")
+    ap.add_argument("-coalesce", default="auto",
+                    choices=["auto", "on", "off"])
+    ap.add_argument("--deadline", type=float, default=900.0,
+                    help="per-phase guard deadline (secs)")
+    return ap.parse_args(argv)
+
+
+def build_context(args):
+    """Configured, prepared context over the (possibly global) device
+    list — called on every process; XLA keeps the SPMD programs in
+    lockstep because each builds the identical mesh from the identical
+    global list."""
+    from yask_tpu import yk_factory
+    from yask_tpu.runtime.init_utils import init_solution_vars
+
+    fac = yk_factory()
+    env = fac.new_env()
+    ctx = fac.new_solution(env, stencil=args.stencil, radius=args.radius)
+    opt = f"-g {args.g}"
+    if args.comm_order:
+        opt += f" -comm_order {args.comm_order}"
+    opt += f" -coalesce {args.coalesce}"
+    ctx.apply_command_line_options(opt)
+    s = ctx.get_settings()
+    s.mode = args.mode
+    s.wf_steps = args.wf_steps
+    for part in args.ranks.split(","):
+        d, _, n = part.partition("=")
+        ctx.set_num_ranks(d.strip(), int(n))
+    fault_point("multihost.prepare")
+    ctx.prepare_solution()
+    init_solution_vars(ctx)
+    return ctx
+
+
+def main(argv=None) -> int:
+    args = parse_args(sys.argv[1:] if argv is None else argv)
+    if args.num_processes > 1:
+        if not args.coordinator:
+            print("--coordinator is required for --num_processes > 1",
+                  file=sys.stderr)
+            return 2
+        from yask_tpu.runtime.env import yk_env
+        yk_env.init_distributed(args.coordinator, args.num_processes,
+                                args.process_id)
+
+    ctx = guarded_call(build_context, args, site="multihost.prepare",
+                       deadline_secs=args.deadline)
+
+    # the schedule every process will execute — identical by
+    # construction (same geometry, same global mesh)
+    plan = ctx.comm_plan()
+    if args.process_id == 0:
+        print("comm plan:", json.dumps(plan.record(), indent=2))
+
+    def run():
+        fault_point("multihost.run")
+        t0 = time.perf_counter()
+        ctx.run_solution(0, args.steps - 1)
+        return time.perf_counter() - t0
+
+    secs = guarded_call(run, site="multihost.run",
+                        deadline_secs=args.deadline)
+    st = ctx.get_stats()
+    if args.process_id == 0:
+        print(st.format())
+        print(f"proc {args.process_id}/{args.num_processes}: "
+              f"{args.steps} steps in {secs:.3f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,12 @@ Rules (see docs/checking.md for the catalog):
   the axon TPU relay and can hang a driver artifact for minutes; only
   the killable-subprocess probes (``_probe_platform``, ``_ready``) and
   explicitly pragma'd TPU-session tools may touch it.
+* ``MESH-DIRECT`` — ``Mesh(...)`` construction outside the single
+  factory (``yask_tpu/parallel/mesh.py``, ``make_mesh``).  The mesh is
+  where the backend becomes config (device list + axis map): scattered
+  constructions fork that decision and break the multi-host launch
+  path, which hands a ``jax.distributed`` global device list to the
+  one factory.
 * ``BARE-DEVICE-CALL`` — device WORK (``run_solution`` /
   ``block_until_ready`` / ``compare_data`` / ``run_auto_tuner_now``)
   in a driver artifact (``bench.py``, ``tools/*.py``) outside any
@@ -32,7 +38,7 @@ Detection of "an Expr value" is lexical (this is a linter, not a type
 checker): names ``expr``/``lhs``/``rhs``/``eq``, the ``*_expr``
 suffix, and attribute access ``.lhs`` / ``.rhs``.  Escape hatch: put
 ``# lint: <rule>-ok`` on the flagged line (rule tokens: ``expr-eq``,
-``expr-key``, ``devices``, ``bare-device-call``).
+``expr-key``, ``devices``, ``mesh``, ``bare-device-call``).
 
 Usage: ``python tools/repo_lint.py [paths...]`` — defaults to the
 repo root; exit 1 when anything fires.
@@ -50,6 +56,8 @@ SKIP_DIRS = {".git", ".perf_bisect", "__pycache__", ".claude",
              ".pytest_cache", "build"}
 # expr.py defines the overloaded operators — == is the DSL there
 EXPR_RULE_EXEMPT = {os.path.join("yask_tpu", "compiler", "expr.py")}
+# mesh.py hosts make_mesh — THE sanctioned Mesh construction site
+MESH_RULE_EXEMPT = {os.path.join("yask_tpu", "parallel", "mesh.py")}
 
 _SUSPECT_NAMES = {"expr", "lhs", "rhs", "eq"}
 _SUSPECT_ATTRS = {"lhs", "rhs"}
@@ -89,6 +97,18 @@ def _is_backend_call(node: ast.Call) -> bool:
     return (isinstance(f, ast.Attribute)
             and f.attr in ("devices", "default_backend")
             and isinstance(f.value, ast.Name) and f.value.id == "jax")
+
+
+def _is_mesh_ctor(node: ast.Call) -> bool:
+    """``Mesh(...)`` / ``jax.sharding.Mesh(...)`` — lexical, like every
+    rule here; names ending in ``Mesh`` other than the jax class are
+    not flagged."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id == "Mesh"
+    if isinstance(f, ast.Attribute):
+        return f.attr == "Mesh"
+    return False
 
 
 class _Linter(ast.NodeVisitor):
@@ -164,6 +184,15 @@ class _Linter(ast.NodeVisitor):
                     "helper — this dials the TPU relay and can hang; "
                     "route through _probe_platform/env, or pragma a "
                     "deliberate TPU-session tool")
+        if (_is_mesh_ctor(node)
+                and self.relpath not in MESH_RULE_EXEMPT
+                and not self._pragma(node.lineno, "mesh")):
+            self._add(
+                "MESH-DIRECT", node,
+                "direct Mesh(...) construction outside the "
+                "parallel.mesh.make_mesh factory — the mesh is config "
+                "(device list + axis map), and forking its construction "
+                "breaks the multi-host launch path; call make_mesh")
         self.generic_visit(node)
 
 

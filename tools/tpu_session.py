@@ -680,6 +680,95 @@ def main(argv=None) -> int:
                     case_anomalies.append(f"overlap-mismatch:{bad}")
             return case_outcome()
 
+        def comm_ab_case():
+            # 3d) message-coalescing A/B: first hardware execution of
+            #     the packed per-(axis,direction) ppermute schedule.
+            #     ppermute only moves bytes, so the arms must be
+            #     bit-identical (corrupt arms withheld — two corrupt
+            #     arms matching proves nothing); each arm banks its
+            #     measured collectives-per-round (traced, not modeled)
+            #     so the round reduction is a hardware datum.
+            ndev = env.get_num_ranks()
+            if ndev <= 1:
+                log("comm_ab", skipped="single device")
+                return {"outcome": "skip", "reason": "single device"}
+            from yask_tpu.parallel.comm_plan import comm_ledger_fields
+            from yask_tpu.runtime.init_utils import init_solution_vars
+            from yask_tpu.utils.exceptions import YaskException
+            go = min(g_bench, 256)
+            steps = 8
+            ranks = ("-nr_x 2 -nr_y 2" if ndev >= 4 and ndev % 4 == 0
+                     else f"-nr_x {ndev}")
+
+            def mk(coal):
+                c = fac.new_solution(env, stencil="iso3dfd", radius=8)
+                c.apply_command_line_options(
+                    f"-g {go} -mode shard_map -measure_halo "
+                    f"-coalesce {coal} {ranks}")
+                c.prepare_solution()
+                init_solution_vars(c)
+                return c
+
+            def run_arm(coal):
+                try:
+                    c = mk(coal)
+                    c.run_solution(0, 3)        # warmup + compile
+                except YaskException as e:
+                    return None, None, str(e)[:200]
+                t0 = time.perf_counter()
+                c.run_solution(4, 4 + steps - 1)
+                dt = time.perf_counter() - t0
+                gpts = round(go ** 3 * steps / dt / 1e9, 3)
+                sanity = check_output(
+                    maybe_corrupt("session.comm.result",
+                                  interior_slice(c)))
+                comm = comm_ledger_fields(c)
+                log("comm_ab", arm=coal, gpts=gpts,
+                    rounds=comm.get("comm_rounds_measured"),
+                    **({"anomalies": sanity["anomalies"]}
+                       if not sanity["ok"] else {}))
+                if should_bank:
+                    record({"metric": (f"iso3dfd r=8 {go}^3 {plat} "
+                                       f"shard_map (coalesce {coal})"),
+                            "value": gpts, "unit": "GPts/s",
+                            "platform": plat, **comm},
+                           sanity=sanity)
+                if not sanity["ok"]:
+                    case_anomalies.extend(sanity["anomalies"])
+                    return None, gpts, None
+                return c, gpts, None
+
+            c_off, g_off, err = run_arm("off")
+            if err:
+                log("comm_ab", error=err)
+                return {"outcome": "skip", "reason": err}
+            c_on, g_on, err = run_arm("on")
+            if err:
+                log("comm_ab", error=err)
+                return {"outcome": "skip", "reason": err}
+            if c_off is not None and c_on is not None:
+                bad = int(c_on.compare_data(c_off, epsilon=0.0,
+                                            abs_epsilon=0.0))
+                rounds_on = comm_ledger_fields(c_on).get(
+                    "comm_rounds_measured")
+                rounds_off = comm_ledger_fields(c_off).get(
+                    "comm_rounds_measured")
+                log("comm_ab", mismatches=bad, rounds_on=rounds_on,
+                    rounds_off=rounds_off)
+                if should_bank and g_off and g_on:
+                    record({"metric": (f"iso3dfd r=8 {go}^3 {plat} "
+                                       "sm-coalesce-speedup"),
+                            "value": round(g_on / g_off, 4),
+                            "unit": "x", "platform": plat,
+                            "serial_gpts": g_off,
+                            "coalesced_gpts": g_on,
+                            "rounds_on": rounds_on,
+                            "rounds_off": rounds_off,
+                            "mismatches": bad})
+                if bad:
+                    case_anomalies.append(f"comm-mismatch:{bad}")
+            return case_outcome()
+
         runner.run_case("chunk_abs", "pipeline_ab", pipeline_case)
         for k in (2, 4):
             runner.run_case("chunk_abs", f"skew_ab.K{k}", skew_case(k))
@@ -688,6 +777,7 @@ def main(argv=None) -> int:
         runner.run_case("chunk_abs", "trapezoid_ab", trapezoid_case)
         runner.run_case("chunk_abs", "bf16_ab", bf16_case)
         runner.run_case("chunk_abs", "overlap_ab", overlap_ab_case)
+        runner.run_case("chunk_abs", "comm_ab", comm_ab_case)
 
     def tune_bench_stages():
         """Stages 4-5 (joint tune + tuned bench): independent context,

@@ -258,6 +258,65 @@ def check_distributed(report: CheckReport, ctx) -> None:
                     f"(overlap_x={setting}): {why}",
                     detail={"reasons": ov_reasons})
 
+    # Communication schedule: the SAME CommPlan the executors consume
+    # (ctx.comm_plan — one definition, checker and runtime cannot
+    # drift).  Plan errors are the class run_shard_map/_prep_shard_pallas
+    # raise at build time; order/coalesce decisions surface as info so a
+    # sweep log records which schedule actually ran.
+    if mode in ("shard_map", "shard_pallas"):
+        try:
+            plan = ctx.comm_plan(K)
+        except Exception as e:  # plan construction itself must not kill
+            plan = None
+            report.add("COMM-PLAN", "warn",
+                       f"comm plan construction failed: {e}",
+                       detail={"message": str(e)})
+        if plan is not None:
+            for msg in plan.errors:
+                report.add(
+                    "COMM-ORDER", "error",
+                    f"comm schedule invalid: {msg} — the build would "
+                    "raise; fix -comm_order or leave it empty for the "
+                    "cost-model ordering",
+                    detail={"message": msg, "order": list(plan.order)})
+            if not plan.errors:
+                kinds = {a: plan.axes[a].get("kind", "ici")
+                         for a in plan.order}
+                # A DCN (cross-process) axis scheduled after an ICI axis
+                # serializes the slow hop behind fast ones — only an
+                # explicit -comm_order can produce this (auto sorts DCN
+                # first).
+                seen_ici = None
+                for a in plan.order:
+                    if kinds[a] == "ici":
+                        seen_ici = a
+                    elif kinds[a] == "dcn" and seen_ici is not None:
+                        report.add(
+                            "COMM-DCN-ORDER", "warn",
+                            f"DCN axis '{a}' is ordered after ICI axis "
+                            f"'{seen_ici}': the slowest link starts "
+                            "last, so its latency cannot hide behind "
+                            "the ICI rounds; put DCN axes first",
+                            dim=a,
+                            detail={"order": list(plan.order),
+                                    "kinds": kinds})
+                if plan.coalesce:
+                    report.add(
+                        "COMM-PLAN", "info",
+                        f"comm schedule: order {list(plan.order)}, "
+                        f"coalesced — {plan.rounds} collective round(s) "
+                        f"per exchange vs {plan.rounds_serial} serial "
+                        "(one ppermute per buffer slab)",
+                        detail=plan.record())
+                elif plan.order:
+                    report.add(
+                        "COMM-SERIAL", "info",
+                        f"comm schedule: order {list(plan.order)}, "
+                        "serial per-buffer collectives "
+                        f"({plan.rounds_serial} per exchange; "
+                        f"coalescing would issue {2 * len(plan.order)})",
+                        detail=plan.record())
+
     # Distributed skew-margin proof: each dim the profit gate would
     # engage (restricted to unsharded dims) needs K·r left and r+E_sk
     # right inside the radius×K ghost pads — right-cover holds exactly

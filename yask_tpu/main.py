@@ -82,6 +82,18 @@ class HarnessSettings:
 from yask_tpu.runtime.init_utils import init_solution_vars as _init_vars
 
 
+def _comm_fields(ctx, mode) -> dict:
+    """Comm-schedule ledger fields for the explicit shard modes; {} on
+    single-device paths (no exchanged axes, nothing to record)."""
+    if mode not in ("shard_map", "shard_pallas"):
+        return {}
+    from yask_tpu.parallel.comm_plan import comm_ledger_fields
+    try:
+        return comm_ledger_fields(ctx)
+    except Exception:
+        return {}
+
+
 def _build(opts: HarnessSettings, extra_args: List[str]):
     from yask_tpu import yk_factory
     fac = yk_factory()
@@ -252,7 +264,11 @@ def run_harness(argv: Optional[List[str]] = None, out=None) -> int:
                    # toward 1; the serial arm shows XLA's baseline)
                    **({"halo_overlap_eff":
                        round(st.get_halo_overlap_eff(), 4)}
-                      if st.get_halo_overlap_eff() > 0 else {})})
+                      if st.get_halo_overlap_eff() > 0 else {}),
+                   # comm schedule: mesh shape, per-axis bytes, and
+                   # collective-round counts, so coalescing A/Bs are
+                   # distinguishable series in the ledger
+                   **(_comm_fields(ctx, mode))})
         out.write(f"ledger: recorded '{key}' "
                   f"(guard {row['guard'].get('status')})\n")
     return 0

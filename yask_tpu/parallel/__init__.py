@@ -7,11 +7,17 @@ halo exchange becomes ``lax.ppermute`` neighbor shifts over ICI inside
 ``shard_map`` (or XLA-inserted collectives in ``sharded`` mode).
 """
 
-from yask_tpu.parallel.mesh import build_mesh, state_shardings
+from yask_tpu.parallel.mesh import build_mesh, make_mesh, state_shardings
+from yask_tpu.parallel.comm_plan import (
+    CommPlan,
+    build_comm_plan,
+    comm_ledger_fields,
+)
 from yask_tpu.parallel.decomp import (
     factorize_rank_grid,
     validate_shard_geometry,
 )
 
-__all__ = ["build_mesh", "state_shardings", "factorize_rank_grid",
-           "validate_shard_geometry"]
+__all__ = ["build_mesh", "make_mesh", "state_shardings",
+           "CommPlan", "build_comm_plan", "comm_ledger_fields",
+           "factorize_rank_grid", "validate_shard_geometry"]

@@ -15,20 +15,37 @@ import numpy as np
 from yask_tpu.utils.exceptions import YaskException
 
 
-def build_mesh(env, opts):
-    """Mesh over the device grid implied by ``opts.num_ranks``."""
+def make_mesh(devices, axis_sizes):
+    """THE mesh factory — the single ``jax.sharding.Mesh`` construction
+    site in the repo (``repo_lint``'s MESH-DIRECT rule enforces it).
+
+    ``devices`` is a flat device list; ``axis_sizes`` an ordered
+    ``(name, extent)`` sequence.  Centralizing construction makes the
+    backend a *config*, not a port: a GPU or any other PJRT backend is
+    just a different device list handed in (the device-mesh pattern the
+    multi-backend frameworks use), and multi-host meshes are the same
+    call over a ``jax.distributed``-initialized global device list
+    (``tools/launch_multihost.py``).
+    """
     from jax.sharding import Mesh
-    nr = opts.num_ranks
-    dims = nr.get_dim_names()
-    shape = [nr[d] for d in dims]
+    axis_sizes = list(axis_sizes)
+    dims = [d for d, _n in axis_sizes]
+    shape = [int(n) for _d, n in axis_sizes]
     need = int(np.prod(shape))
-    devs = env.get_devices()
-    if need > len(devs):
+    devices = list(devices)
+    if need > len(devices):
         raise YaskException(
             f"mesh {dict(zip(dims, shape))} needs {need} devices, "
-            f"have {len(devs)}")
-    arr = np.array(devs[:need]).reshape(shape)
+            f"have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(shape)
     return Mesh(arr, axis_names=tuple(dims))
+
+
+def build_mesh(env, opts):
+    """Mesh over the device grid implied by ``opts.num_ranks``."""
+    nr = opts.num_ranks
+    dims = nr.get_dim_names()
+    return make_mesh(env.get_devices(), [(d, nr[d]) for d in dims])
 
 
 def state_shardings(mesh, program, opts) -> Dict[str, object]:

@@ -39,6 +39,21 @@ def _shard_map_fn():
     return shard_map
 
 
+class _TraceStats:
+    """Trace-time collective counter: every ppermute the exchange paths
+    issue bumps ``nperm`` while the program is being traced/lowered.
+    The run paths read the delta around lowering the exchange-only
+    calibration twin, so ``halo-cal`` reports the collective count of
+    the schedule that actually compiled (model-free) — the number the
+    coalescing A/B exists to move."""
+
+    def __init__(self):
+        self.nperm = 0
+
+
+_trace_stats = _TraceStats()
+
+
 def exchange_ghosts(arr, geom, dim_widths: Dict[str, Tuple[int, int]],
                     nr, local_sizes):
     """Fill ``arr``'s ghost pads from neighbor shards for the given dims.
@@ -58,13 +73,115 @@ def exchange_ghosts(arr, geom, dim_widths: Dict[str, Tuple[int, int]],
         sz = local_sizes[d]
         if l > 0:
             slab = lax.slice_in_dim(arr, o + sz - l, o + sz, axis=ax)
+            _trace_stats.nperm += 1
             recv = lax.ppermute(slab, d, [(i, i + 1) for i in range(n - 1)])
             arr = lax.dynamic_update_slice_in_dim(arr, recv, o - l, axis=ax)
         if r > 0:
             slab = lax.slice_in_dim(arr, o, o + r, axis=ax)
+            _trace_stats.nperm += 1
             recv = lax.ppermute(slab, d, [(i + 1, i) for i in range(n - 1)])
             arr = lax.dynamic_update_slice_in_dim(arr, recv, o + sz, axis=ax)
     return arr
+
+
+def _exchange_coalesced(items, nr, local_sizes, order):
+    """Coalesced ghost exchange: ONE ppermute per (mesh axis, direction)
+    carrying every buffer's slab, flattened and concatenated (grouped by
+    dtype), then split/reshaped back into each buffer's ghost band.
+
+    ``ppermute`` only moves bytes, so the result is bit-identical to
+    per-buffer collectives; axes still go strictly in plan order, so the
+    corner composition (a later axis's slab spans the earlier axes'
+    freshly filled ghosts) is preserved — diagonal ghosts keep arriving
+    without dedicated collectives.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+    arrs = [a for a, _g, _w in items]
+    metas = [(g, w) for _a, g, w in items]
+    for d in order:
+        n = nr.get(d, 1)
+        if n <= 1:
+            continue
+        sz = local_sizes[d]
+        for left in (True, False):
+            perm = ([(i, i + 1) for i in range(n - 1)] if left
+                    else [(i + 1, i) for i in range(n - 1)])
+            # dtype -> (flattened slabs, (item idx, axis, write pos,
+            # slab shape, element count))
+            groups: Dict[str, Tuple[list, list]] = {}
+            for i, (g, w) in enumerate(metas):
+                if d not in w or d not in g.domain_dims:
+                    continue
+                wl, wr = w[d]
+                width = wl if left else wr
+                if width <= 0:
+                    continue
+                ax = g.axis_of(d)
+                o = g.origin[d]
+                lo = (o + sz - width) if left else o
+                slab = lax.slice_in_dim(arrs[i], lo, lo + width, axis=ax)
+                wr_at = (o - width) if left else (o + sz)
+                slabs, meta = groups.setdefault(str(slab.dtype),
+                                                ([], []))
+                slabs.append(slab)
+                meta.append((i, ax, wr_at, slab.shape,
+                             int(np.prod(slab.shape))))
+            for slabs, meta in groups.values():
+                if len(slabs) == 1:
+                    # single payload: nothing to pack
+                    i, ax, wr_at, _shp, _n = meta[0]
+                    _trace_stats.nperm += 1
+                    recv = lax.ppermute(slabs[0], d, perm)
+                    arrs[i] = lax.dynamic_update_slice_in_dim(
+                        arrs[i], recv, wr_at, axis=ax)
+                    continue
+                payload = jnp.concatenate(
+                    [jnp.reshape(s, (-1,)) for s in slabs])
+                _trace_stats.nperm += 1
+                recv = lax.ppermute(payload, d, perm)
+                off = 0
+                for i, ax, wr_at, shp, nel in meta:
+                    part = jnp.reshape(
+                        lax.slice_in_dim(recv, off, off + nel, axis=0),
+                        shp)
+                    off += nel
+                    arrs[i] = lax.dynamic_update_slice_in_dim(
+                        arrs[i], part, wr_at, axis=ax)
+    return arrs
+
+
+def exchange_many(items, nr, local_sizes, plan=None,
+                  exchange=exchange_ghosts):
+    """The one multi-buffer exchange entry both shard paths trace.
+
+    ``items`` is a list of ``(padded array, geom, dim_widths)``; returns
+    the exchanged arrays in the same order.  The CommPlan decides the
+    schedule: without one (or with coalescing off, or when ``exchange``
+    is a calibration stand-in like ``_no_exchange``) each buffer runs
+    the serial per-buffer ``exchange`` with its width dims reordered to
+    the plan; with coalescing on, all slabs for one (axis, direction)
+    ride a single concatenated ppermute (``_exchange_coalesced``).
+    Either way axes go in plan order, so corner ghosts stay composed
+    exchanges and both schedules are bit-identical.
+    """
+    if not items:
+        return []
+    order = list(plan.order) if plan is not None else []
+    seen = set(order)
+    for _a, _g, w in items:
+        for d in w:
+            if d not in seen:
+                order.append(d)
+                seen.add(d)
+    if plan is None or not plan.coalesce \
+            or exchange is not exchange_ghosts:
+        out = []
+        for a, g, w in items:
+            ww = {d: w[d] for d in order if d in w}
+            out.append(exchange(a, g, ww, nr, local_sizes))
+        return out
+    return _exchange_coalesced(items, nr, local_sizes, order)
 
 
 def _widen(applied: Dict, key, widths: Dict[str, Tuple[int, int]]):
@@ -200,7 +317,8 @@ def overlap_decision(ctx, K: int, local_prog=None):
     return True, core, shells, reasons
 
 
-def _make_overlap_step(prog, nr, lsizes, exchange=exchange_ghosts):
+def _make_overlap_step(prog, nr, lsizes, plan=None,
+                       exchange=exchange_ghosts):
     """Interior/exterior-split step: the reference's compute/communication
     overlap (``run_solution`` exterior-then-interior structure,
     ``context.cpp:377-478``, ``MpiSection`` flags ``context.hpp:789-833``)
@@ -243,6 +361,10 @@ def _make_overlap_step(prog, nr, lsizes, exchange=exchange_ghosts):
             # array of an earlier stage, and the newest ring slot for
             # previous-step reads (a var can need both; refreshing only
             # computed would rotate stale ghosts into the next step)
+            # ... batched through exchange_many so a coalescing
+            # CommPlan packs this stage's refreshes into one ppermute
+            # per (axis, direction)
+            items, tags = [], []
             for vname, widths in split["computed"].items():
                 g = prog.geoms[vname]
                 if not any(nr.get(d, 1) > 1 for d in widths):
@@ -250,9 +372,8 @@ def _make_overlap_step(prog, nr, lsizes, exchange=exchange_ghosts):
                 if vname in computed:
                     union, grew = _widen(post_w, vname, widths)
                     if vname not in computed_post or grew:
-                        computed_post[vname] = exchange(
-                            computed[vname], g, union, nr, lsizes)
-                        post_w[vname] = union
+                        items.append((computed[vname], g, union))
+                        tags.append(("c", vname, union))
             for vname, widths in split["ring"].items():
                 g = prog.geoms[vname]
                 if not any(nr.get(d, 1) > 1 for d in widths):
@@ -260,9 +381,17 @@ def _make_overlap_step(prog, nr, lsizes, exchange=exchange_ghosts):
                 if g.is_written and g.has_step:
                     union, grew = _widen(ring_w, vname, widths)
                     if grew:
+                        items.append((state_post[vname][-1], g, union))
+                        tags.append(("s", vname, union))
+            if items:
+                new = exchange_many(items, nr, lsizes, plan, exchange)
+                for (kind, vname, union), a in zip(tags, new):
+                    if kind == "c":
+                        computed_post[vname] = a
+                        post_w[vname] = union
+                    else:
                         ring = list(state_post[vname])
-                        ring[-1] = exchange(ring[-1], g, union, nr,
-                                            lsizes)
+                        ring[-1] = a
                         state_post[vname] = ring
                         ring_w[vname] = union
 
@@ -472,7 +601,8 @@ def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
 def _build_exchange_only(ctx, names, specs_for, slots, nr, lsizes,
                          gsizes, width_scale: int = 1,
                          written_only: bool = False, extra_pad=None,
-                         uniform_widths=None, exchange=exchange_ghosts):
+                         uniform_widths=None, exchange=exchange_ghosts,
+                         plan=None):
     """One ghost-exchange round compiled alone: pad, exchange at halo
     widths × ``width_scale``, strip — no compute. The second halo
     calibration point (bare collective cost). ``width_scale``/
@@ -502,11 +632,10 @@ def _build_exchange_only(ctx, names, specs_for, slots, nr, lsizes,
                               rank_offset=offs,
                               extra_pad=extra_pad or {},
                               mosaic_align=False)
-        out = {}
+        padded, post, items, locs = {}, {}, [], []
         for k in names:
             g = prog.geoms[k]
             if written_only and not g.is_written:
-                out[k] = list(interior_state[k])
                 continue
             pads, strip = [], []
             for dn, kind in g.axes:
@@ -534,13 +663,27 @@ def _build_exchange_only(ctx, names, specs_for, slots, nr, lsizes,
                     widths[d] = (hl, hr)
             moved = len(interior_state[k]) if not written_only \
                 else min(max(width_scale, 1), len(interior_state[k]))
-            ring = []
-            for si, a in enumerate(interior_state[k]):
-                p = jnp.pad(a, pads) if pads else a
-                if widths and si >= len(interior_state[k]) - moved:
-                    p = exchange(p, g, widths, nr, lsizes)
-                ring.append(p[tuple(strip)] if pads else p)
-            out[k] = ring
+            ring = [jnp.pad(a, pads) if pads else a
+                    for a in interior_state[k]]
+            padded[k] = (ring, pads, strip)
+            if widths:
+                for si in range(len(ring) - moved, len(ring)):
+                    items.append((ring[si], g, widths))
+                    locs.append((k, si))
+        # one batched exchange across every moved slot: under a
+        # coalescing CommPlan the round's collective count is what the
+        # real schedule pays (the twin must mirror it exactly)
+        for (k, si), a in zip(locs,
+                              exchange_many(items, nr, lsizes, plan,
+                                            exchange)):
+            padded[k][0][si] = a
+        out = {}
+        for k in names:
+            if k not in padded:
+                out[k] = list(interior_state[k])
+                continue
+            ring, pads, strip = padded[k]
+            out[k] = [p[tuple(strip)] if pads else p for p in ring]
         return out
 
     try:
@@ -596,10 +739,15 @@ def run_shard_map(ctx, start: int, n: int) -> None:
     slots = {k: len(src_state[k]) for k in names}
     specs_for = _make_specs_for(local_prog, nr)
 
-    # overlap_comms is captured at trace time, so it must key the cache —
-    # otherwise toggling it between equal-length runs silently reuses the
-    # other strategy's compiled body.
-    key = ("shard_map", n, opts.overlap_comms)
+    # The CommPlan (axis order + coalescing) is baked into the traced
+    # exchange bodies, so it joins overlap_comms in the cache key —
+    # toggling either between equal-length runs must never reuse the
+    # other schedule's compiled body.
+    plan = ctx.comm_plan()
+    if plan.errors:
+        raise YaskException("communication plan invalid: "
+                            + "; ".join(plan.errors))
+    key = ("shard_map", n, opts.overlap_comms) + plan.key()
 
     def build(exchange):
         shard_map = _shard_map_fn()
@@ -630,16 +778,22 @@ def run_shard_map(ctx, start: int, n: int) -> None:
 
             # 2) pre-exchange every slot once so older ring slots carry
             #    valid ghosts (steady-state invariant: only the newest slot
-            #    is stale afterwards).
+            #    is stale afterwards) — batched, so a coalescing CommPlan
+            #    packs all slabs per (axis, direction) into one ppermute.
+            items, locs = [], []
             for k in names:
                 g = prog.geoms[k]
                 widths = {d: g.var.halo.get(d, (0, 0))
                           for d in g.domain_dims}
                 widths = {d: w for d, w in widths.items() if w != (0, 0)}
                 if widths:
-                    state[k] = [
-                        exchange(a, g, widths, nr, lsizes)
-                        for a in state[k]]
+                    for si, a in enumerate(state[k]):
+                        items.append((a, g, widths))
+                        locs.append((k, si))
+            for (k, si), a in zip(locs,
+                                  exchange_many(items, nr, lsizes,
+                                                plan, exchange)):
+                state[k][si] = a
 
             # 3) scan steps; before each stage refresh stale ghosts only.
             def one_step_plain(st, t):
@@ -651,35 +805,46 @@ def run_shard_map(ctx, start: int, n: int) -> None:
                     # refresh BOTH buffers a stage's reads can hit (see
                     # stage_read_widths_split: refreshing only the
                     # computed array would leave previous-step ring
-                    # reads of the same var with stale shard ghosts)
+                    # reads of the same var with stale shard ghosts) —
+                    # batched through exchange_many so the stage's
+                    # refreshes share collectives under a coalescing
+                    # CommPlan
                     split = prog.stage_reads_split[si]
+                    items, tags = [], []
                     for vname, widths in split["computed"].items():
                         if vname not in computed:
                             continue
                         g2 = prog.geoms[vname]
                         u, grew = _widen(applied, (vname, "c"), widths)
                         if grew:
-                            computed = {**computed,
-                                        vname: exchange(
-                                            computed[vname], g2, u,
-                                            nr, lsizes)}
-                            applied[(vname, "c")] = u
+                            items.append((computed[vname], g2, u))
+                            tags.append(("c", vname, u))
                     for vname, widths in split["ring"].items():
                         g2 = prog.geoms[vname]
                         if not (g2.is_written and g2.has_step):
                             continue
                         u, grew = _widen(applied, (vname, "s"), widths)
                         if grew:
-                            ring = list(state_[vname])
-                            ring[-1] = exchange(
-                                ring[-1], g2, u, nr, lsizes)
-                            state_ = {**state_, vname: ring}
-                            applied[(vname, "s")] = u
+                            items.append((state_[vname][-1], g2, u))
+                            tags.append(("s", vname, u))
+                    if items:
+                        new = exchange_many(items, nr, lsizes, plan,
+                                            exchange)
+                        for (kind, vname, u), a in zip(tags, new):
+                            if kind == "c":
+                                computed = {**computed, vname: a}
+                                applied[(vname, "c")] = u
+                            else:
+                                ring = list(state_[vname])
+                                ring[-1] = a
+                                state_ = {**state_, vname: ring}
+                                applied[(vname, "s")] = u
                     return state_, computed
 
                 return prog.step(st, t, halo_hook=hook)
 
             one_step_ov = _make_overlap_step(prog, nr, lsizes,
+                                             plan=plan,
                                              exchange=exchange)
             one_step = one_step_ov if ctx._opts.overlap_comms \
                 else one_step_plain
@@ -741,9 +906,13 @@ def run_shard_map(ctx, start: int, n: int) -> None:
             t0c = time.perf_counter()
             tj = jnp.asarray(start, dtype=jnp.int32)
             fn_no = build(_no_exchange).lower(interior, tj).compile()
+            np0 = _trace_stats.nperm
             fn_x = _build_exchange_only(
                 ctx, names, specs_for, slots, nr, lsizes,
-                gsizes).lower(interior, tj).compile()
+                gsizes, plan=plan).lower(interior, tj).compile()
+            # collectives per exchange round, counted off the trace of
+            # the schedule that actually compiled
+            ctx._halo_nperm[key] = _trace_stats.nperm - np0
             fn_p = _build_exchange_only(
                 ctx, names, specs_for, slots, nr, lsizes,
                 gsizes, exchange=_no_exchange) \
@@ -757,6 +926,7 @@ def run_shard_map(ctx, start: int, n: int) -> None:
         ctx._halo_xpack_last = ctx._halo_xpack.get(key, 0.0)
         ctx._halo_cal_spread_last = ctx._halo_cal_spread.get(key, 0.0)
         ctx._halo_cal_unstable_last = ctx._halo_cal_unstable.get(key, False)
+        ctx._halo_nperm_last = ctx._halo_nperm.get(key, 0)
         ctx._halo_overlap_eff_last = 0.0   # shard_pallas-only metric
         cal_secs = time.perf_counter() - t0cal
 
@@ -819,6 +989,14 @@ def _prep_shard_pallas(ctx, n: int, K: int, blk):
             raise YaskException(
                 f"rank domain {lsizes[d]} in dim '{d}' smaller than the "
                 f"fused ghost width {hK[d]} (radius × wf_steps)")
+
+    # Communication schedule for this (mode, K): axis order +
+    # coalescing off the ICI/DCN cost model, baked into the traced
+    # exchange closures below (the variant cache key carries the knobs)
+    plan = ctx.comm_plan(K)
+    if plan.errors:
+        raise YaskException("communication plan invalid: "
+                            + "; ".join(plan.errors))
 
     # Per-shard plan: pads grown to the fused ghost width so the kernel's
     # halo DMAs stay inside the array and exchanges have room.
@@ -941,6 +1119,9 @@ def _prep_shard_pallas(ctx, n: int, K: int, blk):
                 f"{len(ov_shells)} shell slab(s)")
     chunk.tiling["overlap_exchange"] = bool(ov_engage)
     chunk.tiling["overlap_reasons"] = list(ov_reasons)
+    # every per-axis comm decision rides the tiling record (stats /
+    # explain pass / ledger rows read it from here)
+    chunk.tiling["comm"] = plan.record()
     if ov_engage:
         chunk.tiling["overlap_core"] = {d: list(v)
                                         for d, v in ov_core.items()}
@@ -958,23 +1139,36 @@ def _prep_shard_pallas(ctx, n: int, K: int, blk):
             return {d: (hK[d], hK[d]) for d in g.domain_dims
                     if nr.get(d, 1) > 1 and hK[d] > 0}
 
+        def _apply_many(state, items, locs):
+            if not items:
+                return state
+            rings = {}
+            for (k, si), a in zip(locs,
+                                  exchange_many(items, nr, lsizes,
+                                                plan, exchange)):
+                rings.setdefault(k, list(state[k]))[si] = a
+            return {**state, **rings}
+
         def exchange_all(state):
             """Full refresh: every slot of every var (run once up front —
             read-only vars and surviving ring slots keep valid ghosts
-            after this)."""
+            after this), batched so a coalescing CommPlan shares
+            collectives across vars and slots."""
+            items, locs = [], []
             for k in names:
                 g = local_prog.geoms[k]
                 widths = _widths(g)
                 if widths:
-                    state = {**state,
-                             k: [exchange(a, g, widths, nr, lsizes)
-                                 for a in state[k]]}
-            return state
+                    for si, a in enumerate(state[k]):
+                        items.append((a, g, widths))
+                        locs.append((k, si))
+            return _apply_many(state, items, locs)
 
         def exchange_newest(state):
             """Per-group refresh: only the min(K, alloc) slots the chunk
             just produced (it re-zeroed their pads); everything else
             still holds valid ghosts."""
+            items, locs = [], []
             for k in names:
                 g = local_prog.geoms[k]
                 if not g.is_written:
@@ -982,13 +1176,11 @@ def _prep_shard_pallas(ctx, n: int, K: int, blk):
                 widths = _widths(g)
                 if not widths:
                     continue
-                ring = list(state[k])
-                nback = min(K, len(ring))
-                for i in range(len(ring) - nback, len(ring)):
-                    ring[i] = exchange(ring[i], g, widths, nr,
-                                       lsizes)
-                state = {**state, k: ring}
-            return state
+                nback = min(K, len(state[k]))
+                for si in range(len(state[k]) - nback, len(state[k])):
+                    items.append((state[k][si], g, widths))
+                    locs.append((k, si))
+            return _apply_many(state, items, locs)
 
         def body(interior_state, t0):
             offs = {d: lax.axis_index(d) * lsizes[d] if nr[d] > 1 else 0
@@ -1241,12 +1433,16 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
             rad = ctx._ana.fused_step_radius()
             xpad = {d: (rad.get(d, 0) * K, rad.get(d, 0) * K)
                     for d in dims}
+            np0 = _trace_stats.nperm
             fn_x = _build_exchange_only(
                 ctx, names, specs_for, slots_, nr,
                 opts.rank_domain_sizes, gsizes, width_scale=K,
-                written_only=True, extra_pad=xpad, uniform_widths=xpad) \
+                written_only=True, extra_pad=xpad, uniform_widths=xpad,
+                plan=ctx.comm_plan(K)) \
                 .lower(interior,
                        jnp.asarray(start, dtype=jnp.int32)).compile()
+            # collectives per exchange round off the compiled schedule
+            ctx._halo_nperm[key] = _trace_stats.nperm - np0
             fn_p = _build_exchange_only(
                 ctx, names, specs_for, slots_, nr,
                 opts.rank_domain_sizes, gsizes, width_scale=K,
@@ -1264,6 +1460,7 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
         ctx._halo_xpack_last = ctx._halo_xpack.get(key, 0.0)
         ctx._halo_cal_spread_last = ctx._halo_cal_spread.get(key, 0.0)
         ctx._halo_cal_unstable_last = ctx._halo_cal_unstable.get(key, False)
+        ctx._halo_nperm_last = ctx._halo_nperm.get(key, 0)
         # Overlap efficiency: the serial model pays rounds × bare
         # exchange cost per call; the measured halo cost is frac ×
         # t_call.  Their shortfall is the share of the bare collective

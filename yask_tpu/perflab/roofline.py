@@ -68,6 +68,77 @@ def format_roofline(roof: Dict) -> str:
     return "".join(lines)
 
 
+# ---------------------------------------------------------------------------
+# ICI/DCN link model (the comm-side analog of the HBM peak table above in
+# env.py): per-axis link bandwidth + latency by device kind, consumed by
+# the CommPlan scheduler (yask_tpu/parallel/comm_plan.py) to order mesh
+# axes and decide message coalescing.  Pure numbers — this module never
+# imports jax (provenance invariant), so the checker and the CPU proxy
+# can cost a plan without a backend.
+# ---------------------------------------------------------------------------
+
+# (substring match on jax device_kind, lowercased) -> (GB/s per link
+# direction, one-way latency in µs).  ICI figures follow the public
+# per-chip interconnect specs (per-direction share of the torus links);
+# DCN is the inter-host data-center network — orders of magnitude more
+# latency, so axes that cross hosts must start their flight first.
+_ICI_LINKS = (
+    (("v5 lite", "v5e"), (45.0, 1.0)),
+    (("v5p", "v5"), (90.0, 1.0)),
+    (("v6", "trillium"), (90.0, 1.0)),
+    (("v4",), (50.0, 1.0)),
+    (("v3",), (35.0, 1.0)),
+    (("v2",), (25.0, 1.0)),
+)
+_DCN_LINK = (12.5, 25.0)          # ~100 Gb/s NIC share, host-to-host RTT/2
+_ICI_DEFAULT = (40.0, 1.0)        # unknown chip (CPU proxy mesh): any
+#                                   positive numbers — only the ici/dcn
+#                                   asymmetry matters for ordering there
+
+
+def link_model(device_kind: str = "", kind: str = "ici") -> Dict:
+    """Modeled link characteristics for one mesh axis.
+
+    ``device_kind`` — jax's ``device_kind`` string ("" = unknown, e.g.
+    the CPU proxy mesh); ``kind`` — ``"ici"`` for on-slice torus axes,
+    ``"dcn"`` for axes that cross host processes.  Returns
+    ``{"kind", "gbps", "latency_us"}``.
+    """
+    if kind == "dcn":
+        gbps, lat = _DCN_LINK
+    else:
+        kd = (device_kind or "").lower()
+        gbps, lat = _ICI_DEFAULT
+        for keys, spec in _ICI_LINKS:
+            if any(k in kd for k in keys):
+                gbps, lat = spec
+                break
+    return {"kind": kind, "gbps": gbps, "latency_us": lat}
+
+
+def link_secs(nbytes: float, link: Dict) -> float:
+    """Modeled one-way flight time of an ``nbytes`` payload on ``link``
+    (latency + bytes/bandwidth)."""
+    return (link["latency_us"] * 1e-6
+            + float(nbytes) / (link["gbps"] * 1e9))
+
+
+def order_comm_axes(axis_costs: Dict[str, Dict]) -> list:
+    """Exchange ordering off the link model: DCN axes first (their
+    longer flight time needs the most compute to hide under — the
+    rank-order pumping stance of the reference's halo loop,
+    ``context.cpp:377-478``), then ICI axes by descending modeled
+    flight time; ties keep the input (domain-dim) order.
+
+    ``axis_costs`` maps dim -> {"kind": "ici"|"dcn", "secs": float}.
+    """
+    dims = list(axis_costs)
+    return sorted(
+        dims,
+        key=lambda d: (0 if axis_costs[d]["kind"] == "dcn" else 1,
+                       -axis_costs[d]["secs"], dims.index(d)))
+
+
 def vmem_sweep_margin_model(stencil: str = "iso3dfd", radius: int = 8,
                             g: int = 512, fuse_steps: int = 2,
                             budgets_mib=(64, 96, 120),

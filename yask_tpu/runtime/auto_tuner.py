@@ -682,6 +682,55 @@ class AutoTuner:
                             f"(on {r_on * 1e3:.3f} vs off "
                             f"{r_off * 1e3:.3f} ms/step, "
                             f"2-group trials)")
+
+            # Message coalescing on/off as a final A/B at the winning
+            # point (auto only — explicit on/off is the user's call).
+            # Only when the CommPlan models a saving (some axis carries
+            # more than one slab; a one-buffer exchange already sits at
+            # the 2-collectives-per-axis floor).  Timed on two-group
+            # calls like the overlap arm: the walk's one-group trials
+            # never reach a mid-call exchange, so both schedules would
+            # compile to the same program.
+            if getattr(ctx._opts, "coalesce", None) == "auto":
+                kw = max(ctx._opts.wf_steps, 1)
+                plan0 = ctx.comm_plan(kw)
+                if plan0.order and not plan0.errors and \
+                        plan0.rounds_serial > 2 * len(plan0.order):
+                    blkw = tuple(ctx._opts.block_sizes[d] for d in lead)
+                    mbw = ctx._opts.vmem_budget_mb
+                    rates = {}
+                    try:
+                        for co in (False, True):
+                            ctx._opts.coalesce = "on" if co else "off"
+
+                            def mk():
+                                return get_shard_pallas_fn(
+                                    ctx, trial, t_trial, n=2 * kw,
+                                    K=kw, blk=blkw)
+
+                            def call(fn):
+                                nonlocal trial, t_trial
+                                st = fn(trial, jnp.asarray(
+                                    t_trial, dtype=jnp.int32))
+                                jax.block_until_ready(st)
+                                trial = st
+                                t_trial += 2 * kw * dirn
+                            rates[co] = self._measure(
+                                ("spc", kw, blkw, mbw, co), mk,
+                                call=call, k=2 * kw)
+                    finally:
+                        ctx._opts.coalesce = "auto"
+                    r_on = rates.get(True, float("inf"))
+                    r_off = rates.get(False, float("inf"))
+                    if r_on != float("inf") or r_off != float("inf"):
+                        win = r_on < r_off
+                        ctx._opts.coalesce = "on" if win else "off"
+                        ctx._env.trace_msg(
+                            f"auto-tuner: coalesce="
+                            f"{'on' if win else 'off'} "
+                            f"(on {r_on * 1e3:.3f} vs off "
+                            f"{r_off * 1e3:.3f} ms/step, "
+                            f"2-group trials)")
             return best_k
         finally:
             for key in set(ctx._jit_cache) - keys_before:
@@ -695,10 +744,14 @@ class AutoTuner:
             return
         best = min(feasible, key=feasible.get)
         trap_flag = None
+        coal_flag = None
         if best[0] == "sp":     # shard_pallas joint result
             best = best[1:]
         elif best[0] == "trap":  # trapezoid A/B arm won outright
             trap_flag = bool(best[4])
+            best = best[1:4]
+        elif best[0] == "spc":  # coalesce A/B arm won outright
+            coal_flag = bool(best[4])
             best = best[1:4]
         self.ctx._opts.wf_steps = best[0]
         if len(best) > 1:   # joint (k, block-shape) result
@@ -723,6 +776,19 @@ class AutoTuner:
                 if tarms:
                     self.ctx._opts.trapezoid_tiling = bool(
                         min(tarms, key=tarms.get))
+        if hasattr(self.ctx._opts, "coalesce"):
+            if coal_flag is not None:
+                self.ctx._opts.coalesce = "on" if coal_flag else "off"
+            else:
+                # mirror of the trapezoid/overlap pinning: the A/B
+                # answered the question even when a walk key won on raw
+                # rate — pin the faster coalesce arm at the chosen K
+                carms = {kk[4]: v for kk, v in feasible.items()
+                         if len(kk) == 5 and kk[0] == "spc"
+                         and kk[1] == best[0]}
+                if carms:
+                    self.ctx._opts.coalesce = (
+                        "on" if min(carms, key=carms.get) else "off")
         if not hasattr(self.ctx._opts, "overlap_exchange"):
             return
         if len(best) > 3 and best[3] is not None:

@@ -81,6 +81,7 @@ class StencilContext:
             d: 0 for d in self._ana.domain_dims}
         self._jit_cache: Dict = {}
         self._pallas_tiling: Dict = {}  # build key → tiling actually chosen
+        self._comm_plans: Dict = {}     # (mode, K, knobs) → CommPlan
 
         self._run_timer = YaskTimer()
         self._halo_timer = YaskTimer()
@@ -345,6 +346,7 @@ class StencilContext:
         self._cur_step = 0
         self._jit_cache.clear()
         self._pallas_tiling.clear()
+        self._comm_plans.clear()
         self._halo_frac = {}
         self._halo_xround = {}       # key -> secs per bare exchange round
         self._halo_xpack = {}        # key -> secs pack-only (no collective)
@@ -352,6 +354,8 @@ class StencilContext:
         self._halo_cal_unstable = {}  # key -> outliers survived re-time
         self._halo_tcall = {}        # key -> secs per full timed call
         self._halo_overlap_eff = {}  # key -> hidden collective fraction
+        self._halo_nperm = {}        # key -> traced collectives per round
+        self._halo_nperm_last = 0
         self._halo_xround_last = 0.0
         self._halo_xpack_last = 0.0
         self._halo_cal_spread_last = 0.0
@@ -707,6 +711,7 @@ class StencilContext:
         self._state_on_device = True
         self._jit_cache.clear()
         self._pallas_tiling.clear()
+        self._comm_plans.clear()
 
     def _pallas_variant_key(self) -> Tuple:
         """(skew, skew_dims_max, vmem_mb) cache-key suffix shared by
@@ -721,7 +726,29 @@ class StencilContext:
         sdm = o.skew_dims_max if o.skew_wavefront else 0
         ovx = getattr(o, "overlap_exchange", "auto")
         trz = None if getattr(o, "trapezoid_tiling", False) else False
-        return (skw, sdm, o.vmem_budget_mb, ovx, trz)
+        # comm-schedule knobs: the shard exchange bodies bake the
+        # CommPlan's order/coalescing into the traced program, so
+        # toggling them must never alias another schedule's executable
+        cmo = getattr(o, "comm_order", "")
+        col = getattr(o, "coalesce", "auto")
+        return (skw, sdm, o.vmem_budget_mb, ovx, trz, cmo, col)
+
+    def comm_plan(self, K: Optional[int] = None):
+        """The communication schedule (CommPlan) for the configured
+        shard mode — derived once per (mode, K, knobs) and cached; the
+        shard_map/shard_pallas exchange paths, the checker's COMM rules
+        and the ledger fields all consume this single instance (the
+        TilePlan discipline applied to collectives)."""
+        from yask_tpu.parallel.comm_plan import build_comm_plan
+        mode = self._mode or self._opts.mode
+        if K is None:
+            K = max(self._opts.wf_steps, 1) if mode == "shard_pallas" \
+                else 1
+        key = (mode, int(K), getattr(self._opts, "comm_order", ""),
+               getattr(self._opts, "coalesce", "auto"))
+        if key not in self._comm_plans:
+            self._comm_plans[key] = build_comm_plan(self, K=K)
+        return self._comm_plans[key]
 
     def _pallas_build_key(self, K: int):
         """(cache key, block tuple, skew arg) for the configured pallas
@@ -1066,6 +1093,7 @@ class StencilContext:
             halo_cal_spread=self._halo_cal_spread_last,
             halo_cal_unstable=self._halo_cal_unstable_last,
             halo_overlap_eff=self._halo_overlap_eff_last,
+            halo_collectives=getattr(self, "_halo_nperm_last", 0),
             read_bytes_pp=rb_pp, write_bytes_pp=wb_pp,
             # aggregate peak: throughput is global (all chips), so the
             # roofline denominator must scale with the mesh size
@@ -1228,6 +1256,7 @@ class StencilContext:
         again."""
         self._jit_cache.clear()
         self._pallas_tiling.clear()
+        self._comm_plans.clear()
         self._state = None
         self._resident = None
         self._program = None

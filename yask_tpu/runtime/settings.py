@@ -102,6 +102,24 @@ class KernelSettings:
         # rank domain admits an aligned core (≥ 2·radius·K),
         # "on" = force (raises when infeasible), "off" = serial.
         self.overlap_exchange = "auto"
+        # Communication-pattern scheduling for the explicit shard modes
+        # (shard_map / shard_pallas), decided by the CommPlan
+        # (yask_tpu/parallel/comm_plan.py) off the ICI/DCN link model in
+        # perflab.roofline.  comm_order: "" = auto (DCN axes exchange
+        # first so their longer flight hides under more compute, then
+        # ICI by descending modeled flight time); a comma list like
+        # "y,x" forces the order (unknown axes are a CommPlan error —
+        # run paths raise, the checker reports COMM-ORDER).
+        self.comm_order = ""
+        # Message coalescing: pack every buffer's ghost slab for one
+        # (mesh axis, direction) into a single concatenated ppermute
+        # payload instead of one collective per buffer per face.  Pure
+        # data movement — bit-identical to the serial schedule — but
+        # fewer collective rounds per exchange.  "auto" = on whenever
+        # some axis carries more than one slab, "on" = force,
+        # "off" = serial per-buffer collectives.  The joint auto-tuner
+        # A/Bs on|off at its winning point when left on "auto".
+        self.coalesce = "auto"
         # Let the joint auto-tuner sweep the Pallas VMEM budget
         # (64/96/120 MiB ladder) as an outer tuning axis when
         # vmem_budget_mb is 0 (auto).  Larger budgets admit wider
@@ -201,6 +219,16 @@ class KernelSettings:
             "auto|on|off (core/shell split of the fused K-group; the "
             "interior/exterior MPI-overlap analog).", self,
             "overlap_exchange")
+        parser.add_string_option(
+            "comm_order", "Mesh-axis ghost-exchange order for the shard "
+            "modes, e.g. 'y,x' (empty = auto: DCN axes first, then ICI "
+            "by modeled flight time — see the CommPlan).", self,
+            "comm_order")
+        parser.add_string_option(
+            "coalesce", "Ghost-exchange message coalescing: auto|on|off "
+            "(one concatenated ppermute per mesh axis and direction "
+            "instead of one collective per buffer per face).", self,
+            "coalesce")
         parser.add_int_option(
             "vmem_mb", "Pallas VMEM budget in MiB (0 = derive from the "
             "device).", self, "vmem_budget_mb")

@@ -19,6 +19,7 @@ class yk_stats:
                  halo_cal_spread: float = 0.0,
                  halo_cal_unstable: bool = False,
                  halo_overlap_eff: float = 0.0,
+                 halo_collectives: int = 0,
                  read_bytes_pp: float = 0.0, write_bytes_pp: float = 0.0,
                  hbm_peak: float = 0.0, tiling: dict | None = None):
         self._npts = npts
@@ -34,6 +35,7 @@ class yk_stats:
         self._halo_cal_spread = halo_cal_spread
         self._halo_cal_unstable = halo_cal_unstable
         self._halo_overlap_eff = halo_overlap_eff
+        self._halo_collectives = halo_collectives
         self._rb_pp = read_bytes_pp
         self._wb_pp = write_bytes_pp
         self._hbm_peak = hbm_peak
@@ -125,6 +127,15 @@ class yk_stats:
         logic ignores such rows."""
         return self._halo_cal_unstable
 
+    def get_halo_collectives(self) -> int:
+        """Collectives (ppermutes) one full ghost-exchange round issues
+        under the scheduled comm plan — counted while tracing the
+        exchange-only calibration twin, so it is the executed schedule,
+        not a model.  Message coalescing (CommPlan) drops this to
+        2 × (exchanged mesh axes); the serial per-buffer schedule pays
+        2 × slabs per axis.  0 before halo calibration runs."""
+        return self._halo_collectives
+
     def get_halo_overlap_eff(self) -> float:
         """Fraction of the bare collective cost the shard_pallas
         schedule hid: 1 − measured-halo-cost / (rounds × bare exchange
@@ -166,6 +177,9 @@ class yk_stats:
                    if self._halo_cal_unstable else "")
                 + f"halo-collective (sec): "
                 f"{self.get_halo_collective_secs():.6g}\n"
+                + (f"halo-collectives-per-round: "
+                   f"{self._halo_collectives}\n"
+                   if self._halo_collectives else "")
                 + (f"halo-overlap-eff (%): "
                    f"{100.0 * self._halo_overlap_eff:.4g}\n"
                    if self._halo_overlap_eff > 0 else "")
