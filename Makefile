@@ -7,7 +7,7 @@ TEST_ENV ?= PALLAS_AXON_POOL_IPS=
 
 .PHONY: all native capi test test-fast scratch-tests boundary-tests \
         stages-tests mode-tests bench perfcheck faultcheck commcheck \
-        examples clean list-stencils lint check
+        cachecheck examples clean list-stencils lint check
 
 all: native test
 
@@ -51,10 +51,18 @@ lint:
 		echo "ruff not installed; skipped (repo_lint ran)"; \
 	fi
 
+# the persistent AOT compile cache end-to-end: digest/memo/disk units,
+# the cross-process reuse acceptance test (second process lowers ZERO
+# times), eviction bounds, corrupt-entry and injected cache.load /
+# cache.store fault fallback (see docs/performance.md)
+cachecheck: lint
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_cache.py tests/test_ensemble.py -q
+
 # static checker over the flagship configs: Mosaic legality, VMEM
 # feasibility (incl. the round-3 spill-OOM class), races, explain.
 # See docs/checking.md; nonzero exit on any error-severity finding.
-check:
+check: cachecheck
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker \
 		-stencil iso3dfd -radius 8 -g 256 -mode pallas -wf_steps 2
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker -all_stencils

@@ -173,6 +173,62 @@ def test_dist_ghost_pad_insufficient():
     assert fired[0].detail == {"rank_domain": 12, "ghost": 32}
 
 
+# ---- cache pass: compile-cache hygiene + ensemble feasibility -------------
+
+def test_ensemble_infeasible_fires_on_sharded_mode():
+    ctx = build_ctx(args="-g 64 -mode shard_map -ensemble 4 "
+                         "-nr_x 2 -nr_y 1 -nr_z 1")
+    rep = run_checks(ctx, passes=["cache"])
+    fired = [d for d in rep.errors if d.rule == "ENSEMBLE-INFEASIBLE"]
+    assert fired and fired[0].detail["ensemble"] == 4
+    assert "mesh" in fired[0].message
+
+
+def test_ensemble_feasible_is_info_and_off_at_one():
+    ctx = build_ctx(args="-g 32 -mode jit -ensemble 4")
+    rep = run_checks(ctx, passes=["cache"])
+    assert rep.ok()
+    infos = [d for d in rep.by_severity("info")
+             if d.rule == "ENSEMBLE-INFEASIBLE"]
+    assert infos and infos[0].detail["mode"] == "jit"
+    # ensemble=1 (the default) emits nothing at all
+    ctx = build_ctx(args="-g 32 -mode ref")
+    rep = run_checks(ctx, passes=["cache"])
+    assert "ENSEMBLE-INFEASIBLE" not in rules(rep)
+
+
+def test_cache_stale_scan(tmp_path, monkeypatch):
+    import pickle
+    from yask_tpu.cache import backend_fingerprint
+    from yask_tpu.cache.compile_cache import SCHEMA as CSCHEMA
+    cur = backend_fingerprint("cpu")
+    stale_fp = dict(cur, jax="0.0.0-other")
+    (tmp_path / "aaaa.aotc").write_bytes(pickle.dumps(
+        {"schema": CSCHEMA, "key": "k1", "fingerprint": stale_fp,
+         "payload": b"", "in_tree": b"", "out_tree": b""}))
+    (tmp_path / "bbbb.aotc").write_bytes(pickle.dumps(
+        {"schema": CSCHEMA, "key": "k2", "fingerprint": cur,
+         "payload": b"", "in_tree": b"", "out_tree": b""}))
+    (tmp_path / "cccc.aotc").write_bytes(b"not a pickle at all")
+    monkeypatch.setenv("YT_COMPILE_CACHE", str(tmp_path))
+    ctx = build_ctx(args="-g 32")
+    rep = run_checks(ctx, passes=["cache"])
+    assert rep.ok()   # hygiene findings are warnings, never errors
+    warns = [d for d in rep.warnings if d.rule == "CACHE-STALE"]
+    assert len(warns) == 2
+    stale = next(d for d in warns if "fingerprint" in d.message)
+    assert stale.detail["stale_count"] == 1
+    corrupt = next(d for d in warns if "unreadable" in d.message)
+    assert corrupt.detail["unreadable_count"] == 1
+
+
+def test_cache_pass_silent_without_cache_dir(monkeypatch):
+    monkeypatch.delenv("YT_COMPILE_CACHE", raising=False)
+    ctx = build_ctx(args="-g 32")
+    rep = run_checks(ctx, passes=["cache"])
+    assert rep.diagnostics == [] and rep.passes == ["cache"]
+
+
 # ---- the round-3 regression shape -----------------------------------------
 
 def test_round3_vmem_spill_oom_flagged_statically():

@@ -212,6 +212,61 @@ def test_bare_device_call_pragma(tmp_path):
     assert fs == []
 
 
+def test_compile_direct_fires_on_chain(tmp_path):
+    fs = lint_src(tmp_path, """\
+        import jax
+
+        def build(fn, state):
+            return jax.jit(fn).lower(state, 0).compile()
+    """)
+    assert fired(fs) == ["COMPILE-DIRECT"]
+
+
+def test_compile_direct_fires_on_prejitted_chain(tmp_path):
+    # the shard builders return jax.jit objects; chaining off them
+    # directly is the same bypass
+    fs = lint_src(tmp_path, """\
+        def build(jitted, state):
+            return jitted.lower(state, 0).compile()
+    """)
+    assert fired(fs) == ["COMPILE-DIRECT"]
+
+
+def test_compile_direct_not_fooled_by_str_lower_or_frontend(tmp_path):
+    fs = lint_src(tmp_path, """\
+        def f(soln, kind):
+            csol = soln.compile(dtype="float32")
+            low = kind.lower()
+            lowered = jax.jit(g).lower(state, 0)   # no .compile(): ok
+            return csol, low, lowered
+    """)
+    assert fs == []
+
+
+def test_compile_direct_serialize_import(tmp_path):
+    fs = lint_src(tmp_path, """\
+        from jax.experimental.serialize_executable import serialize
+        import jax.experimental.serialize_executable as se
+    """)
+    assert fired(fs) == ["COMPILE-DIRECT", "COMPILE-DIRECT"]
+
+
+def test_compile_direct_exempt_in_cache_and_pragma(tmp_path):
+    (tmp_path / "yask_tpu" / "cache").mkdir(parents=True)
+    fs = lint_src(tmp_path, """\
+        from jax.experimental.serialize_executable import serialize
+
+        def fresh(fn, args):
+            return jax.jit(fn).lower(*args).compile()
+    """, name=os.path.join("yask_tpu", "cache", "compile_cache.py"))
+    assert fs == []
+    fs = lint_src(tmp_path, """\
+        def view(fn, state):
+            return jax.jit(fn).lower(state, 0).compile()  # lint: compile-direct-ok
+    """)
+    assert fs == []
+
+
 def test_repo_is_clean():
     findings = repo_lint.run_lint([ROOT], root=ROOT)
     assert findings == [], findings

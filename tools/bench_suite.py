@@ -10,7 +10,10 @@ of ``bench.py``:
 * iso3dfd in bf16 on the validated pallas path (HBM roofline lever);
 * iso3dfd small-radius trapezoid-vs-skew A/B (the two-phase
   parallel-grid tiling, correctness-gated, TPU-scoped sentinel floor);
-* awp, domain-decomposed with measured halo fraction (multi-device).
+* awp, domain-decomposed with measured halo fraction (multi-device);
+* ensemble batched-vs-sequential A/B (N instances as one vmapped
+  program vs N fresh contexts each paying its own compile — the
+  parameter-sweep regime; bit-identity gated per member).
 
 Every section is independent (a failure emits an error line and the
 suite continues), pallas numbers are correctness-gated against the jit
@@ -468,6 +471,115 @@ def run_suite(fac, env, budget_secs=None):
              **_comm_of(c_on))
         del c_on, c_off
 
+    def ensemble_ab():
+        # Batched-vs-sequential ensemble A/B at the parameter-sweep
+        # point (N=8 at 64³ off-TPU): the sequential arm is N FRESH
+        # contexts each paying its own trace+lower+compile — today's
+        # aggregate cost of a sweep — with the compile-cache memo
+        # cleared per member and disk persistence off, so the
+        # chokepoint cannot quietly share compiles between arms.  The
+        # batched arm is ONE context + new_ensemble(N): one vmapped
+        # compile, one fused run.  Correctness gate: every member must
+        # be BIT-identical (all vars, all ring slots) to its
+        # sequential twin — vmap adds a leading axis, never changes
+        # per-lane arithmetic.  The ≥2× ENSEMBLE_SPEEDUP_FLOOR is
+        # CPU-scoped (compile dominates at 64³ on the proxy; re-base
+        # on hardware where the chip-saturation win takes over).
+        import numpy as np
+        from yask_tpu import cache as ccache
+        try:
+            N = int(os.environ.get("YT_BENCH_ENSEMBLE", "8"))
+        except ValueError:
+            N = 8
+        if N < 2:
+            return
+        g = 128 if on_tpu else 64
+
+        def seed(ctx, i):
+            rng = np.random.RandomState(1000 + i)
+            arr = (rng.rand(g, g, g).astype(np.float32) - 0.5) * 0.1
+            ctx.get_var("pressure").set_elements_in_slice(
+                arr, [0, 0, 0, 0], [0, g - 1, g - 1, g - 1])
+
+        # Both arms time ONLY the runs: context build + initial
+        # conditions are identical per-member host work (numpy fills)
+        # that would dilute the signal equally on both sides.  The
+        # first run_solution/ens.run still pays trace+lower+compile —
+        # that asymmetry (N compiles vs one vmapped compile) is the
+        # thing being measured.
+        def seq_arm():
+            ctxs = []
+            for i in range(N):
+                ctx = build(fac, env, "iso3dfd", 8, g, "jit")
+                seed(ctx, i)
+                ctxs.append(ctx)
+            t0s = time.perf_counter()
+            for ctx in ctxs:
+                # identical geometry ⇒ identical persistent key: the
+                # memo would hand member 2..N member 1's executable
+                # and measure a sweep that paid one compile, not N
+                ccache.clear_memo()
+                ctx.run_solution(0, steps - 1)
+            t = time.perf_counter() - t0s
+            finals = [{n: [np.asarray(a) for a in ring]
+                       for n, ring in ctx._state.items()}
+                      for ctx in ctxs]
+            del ctxs
+            return t, finals
+
+        def bat_arm():
+            from yask_tpu.runtime.init_utils import init_solution_vars
+            ctx = build(fac, env, "iso3dfd", 8, g, "jit")
+            ens = ctx.new_ensemble(N)
+            for i in range(N):
+                with ens.member(i) as c:
+                    if i:   # member 0 was initialized by build();
+                            # fresh members need the same baseline
+                        init_solution_vars(c)
+                    seed(c, i)
+            ccache.clear_memo()
+            t0b = time.perf_counter()
+            ens.run(0, steps - 1)
+            return time.perf_counter() - t0b, ctx, ens
+
+        saved = os.environ.pop("YT_COMPILE_CACHE", None)
+        try:
+            t_seq, finals = seq_arm()
+            t_bat, ctx, ens = bat_arm()
+        finally:
+            if saved is not None:
+                os.environ["YT_COMPILE_CACHE"] = saved
+        for i in range(N):
+            with ens.member(i) as c:
+                for n, ring in finals[i].items():
+                    for s, a in enumerate(ring):
+                        b = np.asarray(c._state[n][s])
+                        if not np.array_equal(a, b):
+                            raise RuntimeError(
+                                f"ensemble member {i} var {n} slot {s} "
+                                "not bit-identical to its sequential "
+                                f"twin (maxdiff {np.abs(a - b).max()})")
+
+        def remeasure_ratio():
+            sv = os.environ.pop("YT_COMPILE_CACHE", None)
+            try:
+                ts, _ = seq_arm()
+                tb, c2, e2 = bat_arm()
+                del c2, e2
+                return ts / max(tb, 1e-12)
+            finally:
+                if sv is not None:
+                    os.environ["YT_COMPILE_CACHE"] = sv
+
+        emit(f"iso3dfd r=8 {g}^3 {plat} ensemble{N}-speedup",
+             t_seq / max(t_bat, 1e-12), "x", remeasure=remeasure_ratio,
+             ensemble=N, seq_secs=round(t_seq, 3),
+             batched_secs=round(t_bat, 3),
+             compile_ms=round(ctx._compile_secs * 1000.0, 1),
+             cache_hit=ctx._last_cache_hit or "cold",
+             batched_reason=ens.batched_reason)
+        del ctx, ens
+
     # explicit section(...) calls (not a loop over a tuple): repo_lint's
     # BARE-DEVICE-CALL closure sanctions device work lexically, from
     # the names passed into the guard invokers
@@ -481,6 +593,7 @@ def run_suite(fac, env, budget_secs=None):
     section(awp_decomposed, t0, budget_secs)
     section(sm_coalesce, t0, budget_secs)
     section(sp_overlap, t0, budget_secs)
+    section(ensemble_ab, t0, budget_secs)
     return list(ROWS)
 
 

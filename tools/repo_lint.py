@@ -22,6 +22,15 @@ Rules (see docs/checking.md for the catalog):
   constructions fork that decision and break the multi-host launch
   path, which hands a ``jax.distributed`` global device list to the
   one factory.
+* ``COMPILE-DIRECT`` — a chained ``.lower(...).compile()`` executable
+  build, or a ``jax.experimental.serialize_executable`` import,
+  outside ``yask_tpu/cache/``.  Every executable must be built through
+  the one chokepoint (``yask_tpu.cache.aot_compile``): it owns the
+  trace counter, the compile-time accounting, and the persistent
+  on-disk cache — a bypassed build silently loses all three.
+  Detection is the chain (receiver of ``.compile()`` is itself a
+  ``.lower(...)`` call), so ``str.lower()`` and the front-end's
+  ``yc_solution.compile(dtype=...)`` never false-positive.
 * ``BARE-DEVICE-CALL`` — device WORK (``run_solution`` /
   ``block_until_ready`` / ``compare_data`` / ``run_auto_tuner_now``)
   in a driver artifact (``bench.py``, ``tools/*.py``) outside any
@@ -38,7 +47,8 @@ Detection of "an Expr value" is lexical (this is a linter, not a type
 checker): names ``expr``/``lhs``/``rhs``/``eq``, the ``*_expr``
 suffix, and attribute access ``.lhs`` / ``.rhs``.  Escape hatch: put
 ``# lint: <rule>-ok`` on the flagged line (rule tokens: ``expr-eq``,
-``expr-key``, ``devices``, ``mesh``, ``bare-device-call``).
+``expr-key``, ``devices``, ``mesh``, ``compile-direct``,
+``bare-device-call``).
 
 Usage: ``python tools/repo_lint.py [paths...]`` — defaults to the
 repo root; exit 1 when anything fires.
@@ -58,6 +68,9 @@ SKIP_DIRS = {".git", ".perf_bisect", "__pycache__", ".claude",
 EXPR_RULE_EXEMPT = {os.path.join("yask_tpu", "compiler", "expr.py")}
 # mesh.py hosts make_mesh — THE sanctioned Mesh construction site
 MESH_RULE_EXEMPT = {os.path.join("yask_tpu", "parallel", "mesh.py")}
+# yask_tpu/cache/ hosts aot_compile — THE sanctioned executable-build
+# and executable-(de)serialization site
+COMPILE_RULE_EXEMPT_DIR = os.path.join("yask_tpu", "cache") + os.sep
 
 _SUSPECT_NAMES = {"expr", "lhs", "rhs", "eq"}
 _SUSPECT_ATTRS = {"lhs", "rhs"}
@@ -97,6 +110,18 @@ def _is_backend_call(node: ast.Call) -> bool:
     return (isinstance(f, ast.Attribute)
             and f.attr in ("devices", "default_backend")
             and isinstance(f.value, ast.Name) and f.value.id == "jax")
+
+
+def _is_compile_chain(node: ast.Call) -> bool:
+    """``<anything>.lower(...).compile(...)`` — the receiver of
+    ``.compile`` is itself a ``.lower(...)`` call.  Chain detection is
+    what keeps ``"x".lower()`` and ``yc_solution.compile(dtype=...)``
+    out: neither is both links at once."""
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "compile"
+            and isinstance(f.value, ast.Call)
+            and isinstance(f.value.func, ast.Attribute)
+            and f.value.func.attr == "lower")
 
 
 def _is_mesh_ctor(node: ast.Call) -> bool:
@@ -174,7 +199,45 @@ class _Linter(ast.NodeVisitor):
                               "__eq__ is overloaded; key by .skey()")
         self.generic_visit(node)
 
+    def _import_hits_serialize(self, names) -> bool:
+        return any("serialize_executable" in (n or "") for n in names)
+
+    def visit_Import(self, node: ast.Import):
+        if (self._import_hits_serialize(a.name for a in node.names)
+                and not self.relpath.startswith(COMPILE_RULE_EXEMPT_DIR)
+                and not self._pragma(node.lineno, "compile-direct")):
+            self._add(
+                "COMPILE-DIRECT", node,
+                "executable (de)serialization outside yask_tpu/cache/ "
+                "— cache entries are written/read only by the "
+                "aot_compile chokepoint")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        names = [node.module or ""] + [a.name for a in node.names]
+        if (self._import_hits_serialize(names)
+                and not self.relpath.startswith(COMPILE_RULE_EXEMPT_DIR)
+                and not self._pragma(node.lineno, "compile-direct")):
+            self._add(
+                "COMPILE-DIRECT", node,
+                "executable (de)serialization outside yask_tpu/cache/ "
+                "— cache entries are written/read only by the "
+                "aot_compile chokepoint")
+        self.generic_visit(node)
+
     def visit_Call(self, node: ast.Call):
+        if (_is_compile_chain(node)
+                and not self.relpath.startswith(COMPILE_RULE_EXEMPT_DIR)
+                and not self._pragma(node.lineno, "compile-direct")
+                and not self._pragma(getattr(node, "end_lineno",
+                                             node.lineno),
+                                     "compile-direct")):
+            self._add(
+                "COMPILE-DIRECT", node,
+                "chained .lower().compile() executable build outside "
+                "yask_tpu/cache/ — route through "
+                "yask_tpu.cache.aot_compile (trace counter, compile "
+                "accounting, and the persistent cache all live there)")
         if _is_backend_call(node):
             sanctioned = any(f in _PROBE_FUNCS for f in self._func_stack)
             if not sanctioned and not self._pragma(node.lineno, "devices"):

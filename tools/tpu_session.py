@@ -19,8 +19,13 @@ a completed joint tune, and a tuned bench number (VERDICT r3 items
    shard_pallas overlapped-exchange arms when >1 device is attached;
 4. tune: joint (K, block) auto-tuner walk on iso3dfd at the bench size;
 5. report: a BENCH-style JSON line per stage (each perf row is
-   persisted to TPU_RESULTS.jsonl the moment it is measured); then
-6. compile-time A/B of the ``max_vinstr`` tile cap on ssg/swe2d.
+   persisted to TPU_RESULTS.jsonl the moment it is measured);
+6. compile_cache_ab: cold-vs-warm AOT compile through the persistent
+   cache (the warm rebuild must show ZERO lowerings on the cache's
+   trace counter — a disk round-trip of a serialized executable on the
+   real backend) and ensemble_ab: N-member batched-vs-sequential run
+   with per-member bit-identity; then
+7. compile-time A/B of the ``max_vinstr`` tile cap on ssg/swe2d.
 
 Every stage is crash-isolated AND journaled (yask_tpu.resilience):
 each case appends its outcome to SESSION_JOURNAL.jsonl the moment it
@@ -61,7 +66,8 @@ MATRIX = [
     ("test_boundary_3d", None), ("test_misc_2d", None),
 ]
 
-STAGES = ("smoke", "validate", "chunk_abs", "tune_bench", "compile_time")
+STAGES = ("smoke", "validate", "chunk_abs", "tune_bench",
+          "compile_cache_ab", "ensemble_ab", "compile_time")
 
 
 def matrix_cases():
@@ -406,8 +412,9 @@ def main(argv=None) -> int:
             try:
                 chunk, tb = build_pallas_chunk(prog_, interpret=interp,
                                                vmem_budget=vb, **kw)
+                from yask_tpu.cache import aot_compile
                 fn = chunk if interp else \
-                    jax.jit(chunk).lower(state_, 0).compile()
+                    aot_compile(chunk, (state_, 0), platform=plat).fn
                 st1 = fn(state_, 0)
                 jax.block_until_ready(st1)
                 st = st1
@@ -834,6 +841,157 @@ def main(argv=None) -> int:
                     "anomalies": sanity["anomalies"]}
         return {}
 
+    def compile_cache_case():
+        """Cold-vs-warm AOT compile through the persistent cache on the
+        real backend: build+run the flagship jit config twice with the
+        in-memory memo cleared in between, so the second build can ONLY
+        come from a deserialized disk entry.  The warm rebuild must
+        show ZERO lowerings on the cache's trace counter — the
+        serialized-executable round-trip has never run against real
+        Mosaic output, only CPU executables."""
+        import tempfile
+        from yask_tpu import cache as ccache
+        saved = os.environ.get("YT_COMPILE_CACHE")
+        cdir = saved or os.path.join(tempfile.gettempdir(),
+                                     "yt_session_compile_cache")
+        os.environ["YT_COMPILE_CACHE"] = cdir
+        try:
+            ccache.clear_memo()
+            s0 = ccache.stats()
+            c1 = build(fac, env, "iso3dfd", "jit", 64, 8, wf=2)
+            c1.run_solution(0, 1)
+            s1 = ccache.stats()
+            cold_ms = round(c1._compile_secs * 1000.0, 1)
+            cold_hit = c1._last_cache_hit
+            del c1
+            # memo off: the warm build must round-trip through DISK
+            ccache.clear_memo()
+            c2 = build(fac, env, "iso3dfd", "jit", 64, 8, wf=2)
+            c2.run_solution(0, 1)
+            s2 = ccache.stats()
+            warm_ms = round(c2._compile_secs * 1000.0, 1)
+            warm_lowerings = s2["lowerings"] - s1["lowerings"]
+            sanity = check_output(
+                maybe_corrupt("session.cache_result",
+                              interior_slice(c2)))
+            line = {"metric": f"iso3dfd r=8 64^3 {plat} "
+                              "compile-cache-warm-ms",
+                    "value": warm_ms, "unit": "ms", "platform": plat,
+                    "cold_ms": cold_ms, "cold_hit": cold_hit or "cold",
+                    "warm_hit": c2._last_cache_hit,
+                    "warm_lowerings": warm_lowerings,
+                    "disk_hits": s2["disk_hits"] - s1["disk_hits"],
+                    "stores": s1["stores"] - s0["stores"],
+                    "load_failures": (s2["load_failures"]
+                                      - s0["load_failures"])}
+            log("compile_cache_ab", **line,
+                **({"anomalies": sanity["anomalies"]}
+                   if not sanity["ok"] else {}))
+            if should_bank:
+                record(line, sanity=sanity)
+            if not sanity["ok"]:
+                return {"outcome": "anomaly",
+                        "anomalies": sanity["anomalies"]}
+            if warm_lowerings:
+                return {"outcome": "anomaly",
+                        "anomalies": [f"warm-lowerings:"
+                                      f"{warm_lowerings}"]}
+            return {}
+        finally:
+            if saved is None:
+                os.environ.pop("YT_COMPILE_CACHE", None)
+            else:
+                os.environ["YT_COMPILE_CACHE"] = saved
+
+    def ensemble_case():
+        """Batched-vs-sequential ensemble on the real backend: the
+        CPU-proxy win is compile amortization; on hardware the
+        chip-saturation leg (one fused program over N small domains)
+        is measured for the first time.  Per-member bit-identity is
+        the gate; a corrupt arm (sanity guards) is withheld from the
+        comparison and banks quarantined."""
+        from yask_tpu import cache as ccache
+        from yask_tpu.runtime.init_utils import init_solution_vars
+        N = 4
+        ge = 128 if plat == "tpu" else 32
+        steps_e = 4
+
+        def seed(c, i):
+            rng = np.random.RandomState(500 + i)
+            arr = (rng.rand(ge, ge, ge).astype(np.float32) - 0.5) * 0.1
+            c.get_var("pressure").set_elements_in_slice(
+                arr, [0, 0, 0, 0], [0, ge - 1, ge - 1, ge - 1])
+
+        # disk cache off for the A/B: a warm entry from the
+        # compile_cache_ab stage would hand the sequential arm its
+        # compiles for free and invert the ratio's meaning
+        saved = os.environ.pop("YT_COMPILE_CACHE", None)
+        try:
+            ctxs = []
+            for i in range(N):
+                c = build(fac, env, "iso3dfd", "jit", ge, 8, wf=2)
+                seed(c, i)
+                ctxs.append(c)
+            t0s = time.perf_counter()
+            for c in ctxs:
+                ccache.clear_memo()   # identical keys: no memo sharing
+                c.run_solution(0, steps_e - 1)
+            t_seq = time.perf_counter() - t0s
+            finals = [{n: [np.asarray(a) for a in ring]
+                       for n, ring in c._state.items()} for c in ctxs]
+            del ctxs
+
+            c = build(fac, env, "iso3dfd", "jit", ge, 8, wf=2)
+            ens = c.new_ensemble(N)
+            for i in range(N):
+                with ens.member(i) as m:
+                    if i:
+                        init_solution_vars(m)
+                    seed(m, i)
+            ccache.clear_memo()
+            t0b = time.perf_counter()
+            ens.run(0, steps_e - 1)
+            t_bat = time.perf_counter() - t0b
+        finally:
+            if saved is not None:
+                os.environ["YT_COMPILE_CACHE"] = saved
+
+        with ens.member(0):
+            sanity = check_output(
+                maybe_corrupt("session.ensemble_result",
+                              interior_slice(c)))
+        mismatches = 0
+        if sanity["ok"]:   # corrupt batched arm: comparison withheld
+            for i in range(N):
+                with ens.member(i) as m:
+                    for n, ring in finals[i].items():
+                        for s, a in enumerate(ring):
+                            if not np.array_equal(
+                                    a, np.asarray(m._state[n][s])):
+                                mismatches += 1
+        line = {"metric": f"iso3dfd r=8 {ge}^3 {plat} "
+                          f"ensemble{N}-speedup",
+                "value": round(t_seq / max(t_bat, 1e-12), 4),
+                "unit": "x", "platform": plat, "ensemble": N,
+                "seq_secs": round(t_seq, 3),
+                "batched_secs": round(t_bat, 3),
+                "compile_ms": round(c._compile_secs * 1000.0, 1),
+                "cache_hit": c._last_cache_hit or "cold",
+                "batched_reason": ens.batched_reason,
+                "mismatches": mismatches}
+        log("ensemble_ab", **line,
+            **({"anomalies": sanity["anomalies"]}
+               if not sanity["ok"] else {}))
+        if should_bank:
+            record(line, sanity=sanity)
+        if not sanity["ok"]:
+            return {"outcome": "anomaly",
+                    "anomalies": sanity["anomalies"]}
+        if mismatches:
+            return {"outcome": "anomaly",
+                    "anomalies": [f"ensemble-mismatch:{mismatches}"]}
+        return {}
+
     rc = 0
     try:
         if "smoke" in stages:
@@ -861,6 +1019,14 @@ def main(argv=None) -> int:
             runner.run_case("tune_bench", "", tune_bench_stages)
             if runner.last_status == "fault":
                 rc = 1
+
+        # 6) persistent-cache + ensemble A/Bs: cheap (64³/128³ jit) and
+        #    banked before the quick-session validation matrix can
+        #    burn the relay window
+        if "compile_cache_ab" in stages:
+            runner.run_case("compile_cache_ab", "", compile_cache_case)
+        if "ensemble_ab" in stages:
+            runner.run_case("ensemble_ab", "", ensemble_case)
 
         # 5b) quick sessions validate AFTER the perf stages are banked
         if quick and "validate" in stages:

@@ -1,0 +1,22 @@
+"""yask_tpu.cache — the persistent AOT compile cache.
+
+Every executable in the framework is built through
+:func:`aot_compile` (jit step scans, pallas chunks, shard twins,
+tuner candidates); ``tools/repo_lint.py``'s COMPILE-DIRECT rule fails
+any ``.lower(...).compile()`` chain outside this package.  See
+``compile_cache`` for the design and ``docs/performance.md``
+("compile amortization") for the model.
+"""
+
+from yask_tpu.cache.compile_cache import (AotResult, DEFAULT_MAX_ENTRIES,
+                                          SCHEMA, aot_compile,
+                                          backend_fingerprint, cache_dir,
+                                          clear_memo, entry_path,
+                                          iter_entries, key_digest,
+                                          max_entries, reset_stats,
+                                          stats)
+
+__all__ = ["AotResult", "DEFAULT_MAX_ENTRIES", "SCHEMA", "aot_compile",
+           "backend_fingerprint", "cache_dir", "clear_memo",
+           "entry_path", "iter_entries", "key_digest", "max_entries",
+           "reset_stats", "stats"]

@@ -2,7 +2,7 @@
 
 Runs over a solution context + settings WITHOUT executing anything (no
 state allocation, no kernel trace, no device work — planning is pure
-geometry) and emits structured diagnostics.  Four passes:
+geometry) and emits structured diagnostics.  Five passes:
 
 * ``mosaic``      — the probed v5e TC legality rules (lane-128/
                     sublane-8 DMA alignment, misc-first physical order,
@@ -13,6 +13,9 @@ geometry) and emits structured diagnostics.  Four passes:
 * ``races``       — equation-level race rules (missing-dim, same-point,
                     WAW order, ring depth, scratch write-halo) plus the
                     distributed halo-sufficiency proofs;
+* ``cache``       — persistent compile-cache hygiene (stale/corrupt
+                    entry scan) and ensemble-batching feasibility for
+                    the configured mode;
 * ``explain``     — every pallas/skew/pipelining decision and fallback
                     as a structured reason.
 
@@ -35,7 +38,7 @@ from yask_tpu.utils.exceptions import YaskException
 __all__ = ["CheckReport", "Diagnostic", "SCHEMA", "run_checks",
            "preflight"]
 
-PASSES = ("mosaic", "vmem", "races", "distributed", "explain")
+PASSES = ("mosaic", "vmem", "races", "distributed", "cache", "explain")
 
 
 def _dtype_name(dt) -> str:
@@ -101,6 +104,11 @@ def run_checks(ctx, passes=None) -> CheckReport:
     if "distributed" in want:
         from yask_tpu.checker.races import check_distributed
         check_distributed(report, ctx)
+    # cache pass needs no plan either: entry-metadata scan + the
+    # ensemble feasibility mode property
+    if "cache" in want:
+        from yask_tpu.checker.cache_pass import check_cache
+        check_cache(report, ctx)
 
     if program is not None:
         if "mosaic" in want:

@@ -1,0 +1,93 @@
+"""Compile-amortization pass: persistent-cache hygiene + ensemble
+feasibility, without executing anything.
+
+Two rules:
+
+* ``CACHE-STALE`` — scans the persistent compile cache
+  (``YT_COMPILE_CACHE``) through :func:`yask_tpu.cache.iter_entries`
+  and reports entries that can never be hit again under the current
+  jax/jaxlib/code fingerprint (the fingerprint is hashed into the
+  content address, so a stale entry is dead weight the LRU eviction
+  will cycle out, not a correctness risk) plus unreadable/corrupt
+  files (``aot_compile`` falls back to a fresh compile on these, but
+  they waste an eviction slot each).  One aggregate diagnostic per
+  group — a 64-entry cache must not produce 64 findings.
+* ``ENSEMBLE-INFEASIBLE`` — when ``-ensemble N`` (N>1) is set, asks
+  :func:`yask_tpu.runtime.ensemble.ensemble_feasible` — the ONE
+  feasibility definition the runtime itself consults — whether the
+  configured mode can batch.  A decline is an error: the user asked
+  for a batched sweep and would silently get nothing (the knob only
+  takes effect through ``new_ensemble``, which raises at run time;
+  this surfaces it at preflight instead).
+
+Both rules are pure host work: the cache scan reads entry metadata
+(payloads are never deserialized) and feasibility is a mode property.
+"""
+
+from __future__ import annotations
+
+from yask_tpu.checker.diagnostics import CheckReport
+
+PASS = "cache"
+
+#: fingerprint fields that decide whether an entry can still be hit;
+#: ``platform`` is excluded — an entry for another platform is simply
+#: another platform's entry, not a stale one.
+_STATIC_FP_FIELDS = ("jax", "jaxlib", "code")
+
+
+def check_cache(report: CheckReport, ctx) -> None:
+    report.ran(PASS)
+    opts = ctx._opts
+
+    n = int(getattr(opts, "ensemble", 1) or 1)
+    if n > 1:
+        from yask_tpu.runtime.ensemble import ensemble_feasible
+        ok, why = ensemble_feasible(ctx)
+        mode = getattr(ctx, "_mode", None) or opts.mode
+        if not ok:
+            report.add("ENSEMBLE-INFEASIBLE", "error",
+                       f"ensemble={n} cannot batch: {why}",
+                       detail={"ensemble": n, "mode": mode,
+                               "reason": why})
+        else:
+            report.add("ENSEMBLE-INFEASIBLE", "info",
+                       f"ensemble={n} batches under mode '{mode}'",
+                       detail={"ensemble": n, "mode": mode})
+
+    from yask_tpu.cache import backend_fingerprint, cache_dir, \
+        iter_entries
+    d = cache_dir()
+    if not d:
+        return
+    cur = backend_fingerprint()
+    cur_static = {k: cur.get(k, "") for k in _STATIC_FP_FIELDS}
+    stale, unreadable, total = [], [], 0
+    for path, meta in iter_entries(d):
+        total += 1
+        if "unreadable" in meta:
+            unreadable.append((path, meta["unreadable"]))
+            continue
+        fp = meta.get("fingerprint") or {}
+        if {k: fp.get(k, "") for k in _STATIC_FP_FIELDS} != cur_static:
+            stale.append((path, {k: fp.get(k, "")
+                                 for k in _STATIC_FP_FIELDS}))
+    if stale:
+        report.add("CACHE-STALE", "warn",
+                   f"{len(stale)}/{total} persisted executable(s) in "
+                   f"{d} were built under a different jax/jaxlib/code "
+                   "fingerprint and can never be hit again — dead "
+                   "weight until LRU eviction cycles them out",
+                   detail={"dir": d, "current": cur_static,
+                           "stale": [{"path": p, "fingerprint": f}
+                                     for p, f in stale[:8]],
+                           "stale_count": len(stale)})
+    if unreadable:
+        report.add("CACHE-STALE", "warn",
+                   f"{len(unreadable)}/{total} cache file(s) in {d} "
+                   "are unreadable/corrupt (aot_compile falls back to "
+                   "a fresh compile, but each wastes an eviction slot)",
+                   detail={"dir": d,
+                           "unreadable": [{"path": p, "error": e}
+                                          for p, e in unreadable[:8]],
+                           "unreadable_count": len(unreadable)})

@@ -28,6 +28,7 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
+from yask_tpu.cache import aot_compile
 from yask_tpu.utils.exceptions import YaskException
 
 
@@ -905,18 +906,19 @@ def run_shard_map(ctx, start: int, n: int) -> None:
         if key not in ctx._halo_frac:
             t0c = time.perf_counter()
             tj = jnp.asarray(start, dtype=jnp.int32)
-            fn_no = build(_no_exchange).lower(interior, tj).compile()
+            # unkeyed aot_compile: per-call shard shapes — ctx's own
+            # memo (_halo_frac keyed per variant) is the right cache
+            fn_no = aot_compile(build(_no_exchange), (interior, tj)).fn
             np0 = _trace_stats.nperm
-            fn_x = _build_exchange_only(
+            fn_x = aot_compile(_build_exchange_only(
                 ctx, names, specs_for, slots, nr, lsizes,
-                gsizes, plan=plan).lower(interior, tj).compile()
+                gsizes, plan=plan), (interior, tj)).fn
             # collectives per exchange round, counted off the trace of
             # the schedule that actually compiled
             ctx._halo_nperm[key] = _trace_stats.nperm - np0
-            fn_p = _build_exchange_only(
+            fn_p = aot_compile(_build_exchange_only(
                 ctx, names, specs_for, slots, nr, lsizes,
-                gsizes, exchange=_no_exchange) \
-                .lower(interior, tj).compile()
+                gsizes, exchange=_no_exchange), (interior, tj)).fn
             ctx._compile_secs += time.perf_counter() - t0c
             _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
                                  fn_xonly=fn_x, fn_pack=fn_p)
@@ -1348,9 +1350,10 @@ def get_shard_pallas_fn(ctx, interior, start: int, n: int, K: int, blk,
         if build is None:
             _, _, build = _prep_shard_pallas(ctx, n, K, blk)
         t0c = time.perf_counter()
-        ctx._jit_cache[key] = \
-            jax.jit(build(exchange_ghosts), donate_argnums=0) \
-            .lower(interior, jnp.asarray(start, dtype=jnp.int32)).compile()
+        ctx._jit_cache[key] = aot_compile(
+            build(exchange_ghosts),
+            (interior, jnp.asarray(start, dtype=jnp.int32)),
+            donate_argnums=0).fn
         ctx._compile_secs += time.perf_counter() - t0c
         # only after a successful compile (see _prep_shard_pallas)
         if getattr(build, "tiling", None) is not None:
@@ -1426,30 +1429,26 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
         if need_cal:
             t0cal = time.perf_counter()
             t0c = time.perf_counter()
-            fn_no = jax.jit(build(_no_exchange), donate_argnums=0) \
-                .lower(interior,
-                       jnp.asarray(start, dtype=jnp.int32)).compile()
+            tj = jnp.asarray(start, dtype=jnp.int32)
+            fn_no = aot_compile(build(_no_exchange), (interior, tj),
+                                donate_argnums=0).fn
             slots_ = {k: ctx._program.geoms[k].num_slots for k in names}
             rad = ctx._ana.fused_step_radius()
             xpad = {d: (rad.get(d, 0) * K, rad.get(d, 0) * K)
                     for d in dims}
             np0 = _trace_stats.nperm
-            fn_x = _build_exchange_only(
+            fn_x = aot_compile(_build_exchange_only(
                 ctx, names, specs_for, slots_, nr,
                 opts.rank_domain_sizes, gsizes, width_scale=K,
                 written_only=True, extra_pad=xpad, uniform_widths=xpad,
-                plan=ctx.comm_plan(K)) \
-                .lower(interior,
-                       jnp.asarray(start, dtype=jnp.int32)).compile()
+                plan=ctx.comm_plan(K)), (interior, tj)).fn
             # collectives per exchange round off the compiled schedule
             ctx._halo_nperm[key] = _trace_stats.nperm - np0
-            fn_p = _build_exchange_only(
+            fn_p = aot_compile(_build_exchange_only(
                 ctx, names, specs_for, slots_, nr,
                 opts.rank_domain_sizes, gsizes, width_scale=K,
                 written_only=True, extra_pad=xpad, uniform_widths=xpad,
-                exchange=_no_exchange) \
-                .lower(interior,
-                       jnp.asarray(start, dtype=jnp.int32)).compile()
+                exchange=_no_exchange), (interior, tj)).fn
             ctx._compile_secs += time.perf_counter() - t0c
             _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
                                  fn_xonly=fn_x, fn_pack=fn_p)

@@ -29,10 +29,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from yask_tpu.utils.exceptions import YaskException
-from yask_tpu.utils.idx_tuple import IdxTuple
-from yask_tpu.utils.timer import YaskTimer
 from yask_tpu.utils.cli import CommandLineParser
 from yask_tpu.runtime.env import yk_env
+from yask_tpu.runtime.run_state import RunState
 from yask_tpu.runtime.settings import KernelSettings
 from yask_tpu.runtime.stats import yk_stats
 from yask_tpu.runtime.var import yk_var
@@ -65,15 +64,14 @@ class StencilContext:
 
         self._opts = KernelSettings(self._ana.domain_dims)
         self._program = None          # StepProgram (compute geometry)
-        self._state: Optional[Dict[str, List]] = None
-        # Sharded interiors kept device-resident between shard-mode runs
-        # (pads stripped); _state is None while this is set and any host
-        # access materializes lazily (reference persistent var storage,
-        # yk_var.hpp:554).
-        self._resident: Optional[Dict[str, List]] = None
-        self._state_on_device = False
+        # ALL per-run mutable state (var rings, resident shard
+        # interiors, step position, run/halo timers) lives in the
+        # active RunState; the historical attribute names below
+        # (_state, _resident, _cur_step, …) are delegating properties,
+        # so one prepared solution can serve many swapped runs
+        # (ensemble members, repeated sweeps) without re-preparing.
+        self._run = RunState()
         self._vars: Dict[str, yk_var] = {}
-        self._cur_step = 0
         self._mode = None
         self._mesh = None
         self._shardings = None
@@ -83,10 +81,8 @@ class StencilContext:
         self._pallas_tiling: Dict = {}  # build key → tiling actually chosen
         self._comm_plans: Dict = {}     # (mode, K, knobs) → CommPlan
 
-        self._run_timer = YaskTimer()
-        self._halo_timer = YaskTimer()
         self._compile_secs = 0.0
-        self._steps_done = 0
+        self._last_cache_hit = None     # cache verdict of latest build
 
         self._hooks: Dict[str, List[Callable]] = {
             "before_prepare": [], "after_prepare": [],
@@ -102,6 +98,99 @@ class StencilContext:
             else:
                 exec(compile(str(code), "<call_after_new_solution>",
                              "exec"), {"kernel_soln": self})
+
+    # ------------------------------------------------------------------
+    # per-run state delegation (RunState hoist)
+    # ------------------------------------------------------------------
+    # The historical attribute names stay valid for every consumer
+    # (var.py, shard_step.py, the tools) but resolve through the
+    # active RunState so whole runs can be swapped under one prepared
+    # solution (ensemble batching, repeated sweeps).
+
+    @property
+    def _state(self):
+        return self._run.state
+
+    @_state.setter
+    def _state(self, v):
+        self._run.state = v
+
+    @property
+    def _resident(self):
+        return self._run.resident
+
+    @_resident.setter
+    def _resident(self, v):
+        self._run.resident = v
+
+    @property
+    def _state_on_device(self):
+        return self._run.state_on_device
+
+    @_state_on_device.setter
+    def _state_on_device(self, v):
+        self._run.state_on_device = v
+
+    @property
+    def _cur_step(self):
+        return self._run.cur_step
+
+    @_cur_step.setter
+    def _cur_step(self, v):
+        self._run.cur_step = v
+
+    @property
+    def _steps_done(self):
+        return self._run.steps_done
+
+    @_steps_done.setter
+    def _steps_done(self, v):
+        self._run.steps_done = v
+
+    @property
+    def _run_timer(self):
+        return self._run.run_timer
+
+    @property
+    def _halo_timer(self):
+        return self._run.halo_timer
+
+    def get_run_state(self) -> RunState:
+        """The active per-run state bundle."""
+        return self._run
+
+    def set_run_state(self, rs: RunState) -> RunState:
+        """Swap in another run's state bundle; returns the previous
+        one.  The solution side (program, jit cache, tiling) is
+        untouched — that is the point: one compile, many runs."""
+        prev, self._run = self._run, rs
+        return prev
+
+    def new_run_state(self) -> RunState:
+        """A fresh zero-state run over the prepared geometry (the
+        ensemble-member allocator).  Mirrors ``prepare_solution``'s
+        allocation: zero-filled rings, pads identically zero,
+        shardings applied when the mode shards resting state."""
+        self._check_prepared()
+        rs = RunState()
+        rs.state = self._program.alloc_state()
+        rs.state_on_device = True
+        if self._shardings is not None:
+            import jax
+            rs.state = {name: [jax.device_put(a, self._shardings[name])
+                               for a in ring]
+                        for name, ring in rs.state.items()}
+        return rs
+
+    def new_ensemble(self, n: Optional[int] = None) -> "EnsembleRun":
+        """N members of this prepared solution batched as one vmapped
+        program (``yask_tpu.runtime.ensemble``).  ``n`` defaults to
+        the ``-ensemble`` setting; member 0 adopts the context's
+        current run state (initial conditions already set stay
+        member 0's)."""
+        from yask_tpu.runtime.ensemble import EnsembleRun
+        return EnsembleRun(self, n if n is not None
+                           else max(self._opts.ensemble, 1))
 
     # ------------------------------------------------------------------
     # identity / settings / vars
@@ -551,14 +640,37 @@ class StencilContext:
                 self._state = prog.step(self._state, t)
                 t += self._ana.step_dir
 
+    def _persistent_key(self, kind: str, **build) -> Tuple:
+        """Cross-process cache key for :func:`yask_tpu.cache.aot_compile`.
+
+        The key must fully determine the traced program: the equation
+        structure (``skey`` covers radii, coefficients, conditions —
+        the solution *name* alone under-keys, e.g. radius is a
+        constructor arg), the padded state geometry the trace bakes in
+        (shapes, origins, ring depths), dtype, step direction, and the
+        caller's build parameters (step count / fuse depth / variant
+        tuple via ``**build``).  The jax/platform/git fingerprint is
+        NOT here — ``aot_compile`` hashes it into the content address
+        itself."""
+        import hashlib
+        eqs = hashlib.sha256(
+            repr([e.skey() for e in self._soln.get_equations()])
+            .encode()).hexdigest()[:16]
+        geoms = tuple(
+            (name, tuple(g.shape), g.alloc, g.is_scratch,
+             tuple(sorted(g.origin.items())), tuple(g.axes))
+            for name, g in sorted(self._program.geoms.items()))
+        return (kind, self.get_name(), eqs, str(self._program.dtype),
+                self._ana.step_dir, geoms, tuple(sorted(build.items())))
+
     def _get_compiled_chunk(self, n: int):
         """Compiled function advancing exactly ``n`` steps (cached per n;
         the reference caches per-size auto-tuner results the same way)."""
         key = ("compiled", n)
         if key in self._jit_cache:
             return self._jit_cache[key]
-        import jax
         from jax import lax
+        from yask_tpu.cache import aot_compile
         prog = self._program
         dirn = self._ana.step_dir
 
@@ -571,12 +683,14 @@ class StencilContext:
             return st
 
         self._state_to_device()
-        t0c = time.perf_counter()
-        compiled = jax.jit(chunk, donate_argnums=0) \
-            .lower(self._state, 0).compile()
-        self._compile_secs += time.perf_counter() - t0c
-        self._jit_cache[key] = compiled
-        return compiled
+        res = aot_compile(chunk, (self._state, 0),
+                          key=self._persistent_key("jit_chunk", n=n),
+                          platform=self._env.get_platform(),
+                          donate_argnums=0)
+        self._compile_secs += res.compile_secs
+        self._last_cache_hit = res.cache_hit
+        self._jit_cache[key] = res.fn
+        return res.fn
 
     def _run_jit_steps(self, start: int, n: int) -> None:
         """Advance ``n`` steps in chunks of ``wf_steps`` (the temporal-
@@ -765,7 +879,6 @@ class StencilContext:
     def _get_pallas_chunk(self, K: int):
         """Compiled fused-Pallas chunk for K steps with the current block
         settings (cached per (K, block) — the auto-tuner varies both)."""
-        import jax
         key, blk, skw = self._pallas_build_key(K)
         if key not in self._jit_cache:
             from yask_tpu.ops.pallas_stencil import build_pallas_chunk
@@ -786,7 +899,15 @@ class StencilContext:
                 # XLA/Mosaic compilation (mirrors _get_compiled_chunk).
                 # No donation: fuse_vars may share these ring buffers
                 # with a peer context.
-                fn = jax.jit(chunk).lower(self._state, 0).compile()
+                from yask_tpu.cache import aot_compile
+                res = aot_compile(
+                    chunk, (self._state, 0),
+                    key=self._persistent_key("pallas_chunk", K=K,
+                                             blk=blk,
+                                             variant=self._pallas_variant_key()),
+                    platform=self._env.get_platform())
+                fn = res.fn
+                self._last_cache_hit = res.cache_hit
             self._jit_cache[key] = fn
             # only after a successful compile: a Mosaic failure must not
             # leave stats modeling a tiling that never ran

@@ -1,0 +1,252 @@
+"""Ensemble batching: N independent instances of one prepared
+solution run as a single vmapped program.
+
+Small domains (≤128³ — the parameter-sweep / ensemble-seismic-shot
+regime) leave most of a chip idle, and N separate runs pay N
+trace+lower+compiles.  Here the state rings gain a leading batch dim
+(``jnp.stack`` over the members' rings), the step chunk is
+``jax.vmap``ed over it, and the batched executable is built once
+through :func:`yask_tpu.cache.aot_compile` — so N members cost one
+compile and one fused device program per chunk.  The reference's
+analog is one ``yk_solution`` per simulation instance sharing a
+linked kernel library; the :class:`RunState` hoist
+(``yask_tpu/runtime/run_state.py``) is what lets one prepared context
+serve all members.
+
+Feasibility is a *mode* property with a single definition
+(:func:`ensemble_feasible`): the single-device modes (jit / pallas)
+batch; the sharded modes decline with a structured reason (their
+state is mesh-decomposed — batching over an unsharded mesh axis is
+future work), and ``ref`` is the sequential oracle by contract.  The
+checker's ENSEMBLE-INFEASIBLE rule and the bench A/B read the same
+function, so a decline is a diagnosable verdict, not a crash.
+
+Per-member initial conditions and result extraction ride the existing
+interior-coordinate var APIs unchanged: :meth:`EnsembleRun.member`
+swaps the context's active :class:`RunState`, so inside the ``with``
+block every ``yk_var`` call targets that member.
+
+Bit-identity contract: a batched run must produce, per member, the
+same bits as that member run alone (tests/test_ensemble.py) — vmap
+adds a leading axis but the per-lane arithmetic is unchanged.  When
+the vmapped build fails (e.g. a Pallas primitive without a batching
+rule under interpret), the run degrades to sequential members that
+still share the context's compiled chunk, and
+:attr:`EnsembleRun.batched_reason` records why.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import List, Optional, Tuple
+
+from yask_tpu.utils.exceptions import YaskException
+
+#: modes whose whole state lives on one device — the ones a leading
+#: batch dim can simply vmap over.
+BATCHED_MODES = ("jit", "pallas")
+
+
+def ensemble_feasible(ctx) -> Tuple[bool, str]:
+    """Can this configured context batch an ensemble?  Returns
+    ``(ok, reason)`` — the ONE definition the run path, the checker's
+    ENSEMBLE-INFEASIBLE rule, and the bench A/B all consult (a mode's
+    verdict must never differ between preflight and runtime)."""
+    mode = ctx._mode or ctx._opts.mode
+    if mode == "auto":
+        mode = "jit" if ctx._opts.num_ranks.product() <= 1 else "sharded"
+    if mode in BATCHED_MODES:
+        return True, ""
+    if mode == "ref":
+        return False, ("mode 'ref' is the sequential numpy oracle; "
+                       "ensemble batching only applies to the "
+                       "compiled paths (jit/pallas)")
+    return False, (
+        f"mode '{mode}' decomposes state over the device mesh; "
+        "batching would need an unsharded mesh axis (future work) — "
+        "run members sequentially or use -mode jit/pallas")
+
+
+class EnsembleRun:
+    """N members of one prepared solution, run as a batch.
+
+    Member 0 *is* the context's current :class:`RunState` (whatever
+    initial conditions were already set stay member 0's); members
+    1..N-1 get fresh zero-filled states from ``ctx.new_run_state()``.
+    Use :meth:`member` to set per-member initial conditions / read
+    per-member results through the normal var APIs, and :meth:`run`
+    to advance all members together.
+    """
+
+    def __init__(self, ctx, n: int):
+        ctx._check_prepared()
+        if n < 1:
+            raise YaskException(f"ensemble size must be >= 1, got {n}")
+        ok, why = ensemble_feasible(ctx)
+        if not ok:
+            raise YaskException(f"ensemble={n} infeasible: {why}")
+        self._ctx = ctx
+        self._members: List = [ctx.get_run_state()]
+        self._members += [ctx.new_run_state() for _ in range(n - 1)]
+        #: "" after a vmapped run; otherwise why the last run degraded
+        #: to sequential members (still sharing compiled chunks).
+        self.batched_reason = ""
+
+    @property
+    def n(self) -> int:
+        return len(self._members)
+
+    @contextmanager
+    def member(self, i: int):
+        """Make member ``i`` the context's active run state for the
+        block: every var API call inside targets that member."""
+        prev = self._ctx.set_run_state(self._members[i])
+        try:
+            yield self._ctx
+        finally:
+            self._ctx.set_run_state(prev)
+
+    # ------------------------------------------------------------------
+
+    def _stack_states(self):
+        """Leading-batch-dim state: var → ring of (N, *shape) arrays.
+        Stacking copies, so the members' own rings stay valid — the
+        sequential fallback restarts from them untouched."""
+        import jax.numpy as jnp
+        ctx = self._ctx
+        for i in range(self.n):
+            with self.member(i):
+                ctx._check_prepared()
+                ctx._state_to_device()
+        names = list(self._members[0].state)
+        return {
+            name: [jnp.stack([m.state[name][s] for m in self._members])
+                   for s in range(len(self._members[0].state[name]))]
+            for name in names}
+
+    def _unstack_states(self, batched) -> None:
+        for i, m in enumerate(self._members):
+            m.state = {name: [b[i] for b in ring]
+                       for name, ring in batched.items()}
+            m.state_on_device = True
+            m.resident = None
+
+    def _batched_chunk_fn(self, k: int):
+        """vmapped+AOT-compiled chunk advancing every member ``k``
+        steps.  Cached in the context's jit cache under an
+        ensemble-tagged key; persisted via yask_tpu.cache like any
+        other executable (key carries the ensemble width — a batched
+        program must never alias the unbatched one)."""
+        ctx = self._ctx
+        key = ("ens_compiled", self.n, k, ctx._mode)
+        if key in ctx._jit_cache:
+            return ctx._jit_cache[key]
+        import jax
+        from jax import lax
+        from yask_tpu.cache import aot_compile
+        prog = ctx._program
+        dirn = ctx._ana.step_dir
+
+        if ctx._mode == "pallas":
+            from yask_tpu.ops.pallas_stencil import build_pallas_chunk
+            _, blk, skw = ctx._pallas_build_key(k)
+            chunk, _tb = build_pallas_chunk(
+                prog, fuse_steps=k, block=blk,
+                interpret=ctx._env.get_platform() != "tpu",
+                vmem_budget=ctx.vmem_budget(), skew=skw,
+                vinstr_cap=ctx._opts.max_tile_vinstr,
+                max_skew_dims=ctx._opts.skew_dims_max,
+                trapezoid=(None if ctx._opts.trapezoid_tiling
+                           else False))
+        else:
+            def chunk(state, t0):
+                def body(carry, _):
+                    st, t = carry
+                    return (prog.step(st, t), t + dirn), None
+                (st, _), _ = lax.scan(body, (state, t0), None, length=k)
+                return st
+
+        bchunk = jax.vmap(chunk, in_axes=(0, None))
+        res = aot_compile(
+            bchunk, (self._stacked_example, 0),
+            key=ctx._persistent_key("ens_chunk", n=k, ensemble=self.n,
+                                    mode=ctx._mode,
+                                    variant=ctx._pallas_variant_key()),
+            platform=ctx._env.get_platform(), donate_argnums=0)
+        ctx._compile_secs += res.compile_secs
+        ctx._last_cache_hit = res.cache_hit
+        ctx._jit_cache[key] = res.fn
+        return res.fn
+
+    def run(self, first_step_index: int,
+            last_step_index: Optional[int] = None) -> None:
+        """Advance every member over the step range (inclusive) — the
+        ensemble analog of ``run_solution``.  Wall-clock lands in
+        member 0's run timer (it is the *aggregate* batched time, not
+        a per-member cost); every member's ``cur_step``/``steps_done``
+        advance as if run alone."""
+        ctx = self._ctx
+        ctx._check_prepared()
+        if last_step_index is None:
+            last_step_index = first_step_index
+        start, n = ctx._step_seq(first_step_index, last_step_index)
+
+        try:
+            self._run_batched(start, n)
+            self.batched_reason = ""
+        except YaskException:
+            raise
+        except Exception as e:  # noqa: BLE001 - degrade, don't die:
+            # a missing vmap batching rule (Pallas primitives under
+            # interpret) must cost the batching win, not the run.
+            # Member states are untouched (stacking copies), so the
+            # sequential path restarts cleanly and still shares the
+            # context's compiled per-member chunk.
+            self.batched_reason = f"{type(e).__name__}: {e}"
+            self._run_sequential(first_step_index, last_step_index)
+            return
+
+        dirn = ctx._ana.step_dir
+        for m in self._members:
+            m.cur_step = start + n * dirn
+            m.steps_done += n
+
+    def _run_batched(self, start: int, n: int) -> None:
+        import jax
+        ctx = self._ctx
+        batched = self._stack_states()
+        # Example avals for lowering (shapes only — jit caches by
+        # shape; keeping the live dict separate lets donation consume
+        # it while the key stays valid for every group).
+        self._stacked_example = batched
+        if ctx._mode == "pallas":
+            # mirror _run_pallas_steps: fuse depth is bounded by the
+            # K the pads were planned for (wf_steps; 0 → 1), never n
+            wf = min(max(ctx._opts.wf_steps, 1), n)
+        else:
+            wf = ctx._opts.wf_steps if ctx._opts.wf_steps > 0 else n
+        sizes = []
+        rem = n
+        while rem > 0:
+            k = min(wf, rem)
+            sizes.append(k)
+            rem -= k
+        fns = {k: self._batched_chunk_fn(k) for k in set(sizes)}
+        del self._stacked_example
+        dirn = ctx._ana.step_dir
+        t = start
+        with self._members[0].run_timer:
+            st = batched
+            for k in sizes:
+                st = fns[k](st, t)
+                t += k * dirn
+            jax.block_until_ready(st)
+        self._unstack_states(st)
+
+    def _run_sequential(self, first_step_index: int,
+                        last_step_index: int) -> None:
+        for i in range(self.n):
+            with self.member(i):
+                self._ctx.run_solution(first_step_index,
+                                       last_step_index)

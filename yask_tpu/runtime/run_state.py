@@ -1,0 +1,73 @@
+"""Per-run mutable state, hoisted out of :class:`StencilContext`.
+
+A prepared context owns two kinds of state with different lifetimes:
+
+* the *solution* side — compiled program, geometry plan, jit cache,
+  tiling records — built once by ``prepare_solution`` and valid for
+  any number of runs;
+* the *run* side — the var rings, the device-resident shard
+  interiors, the step position, and the run/halo timers — one
+  instance per live simulation.
+
+This module is the run side.  ``StencilContext`` keeps its historical
+attribute names (``_state``, ``_resident``, ``_cur_step``, …) as
+delegating properties onto the active :class:`RunState`, so the var
+APIs and every execution path read/write through it unchanged — but
+the whole bundle can now be swapped: one prepared+compiled solution
+serves many ensemble members (``yask_tpu.runtime.ensemble``) and
+repeated runs without re-preparing.  The reference's analog is one
+``yk_solution`` per simulation instance sharing a linked kernel
+library; here the "library" is the AOT compile cache
+(``yask_tpu.cache``) plus the context's plan.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from yask_tpu.utils.timer import YaskTimer
+
+
+class RunState:
+    """One live simulation's mutable state.
+
+    Fields mirror the context attributes they replaced:
+
+    * ``state`` — dict var → ring (list) of padded device arrays,
+      oldest→newest (None when unallocated or while ``resident``
+      holds the authoritative copy);
+    * ``resident`` — device-resident sharded interiors between
+      shard-mode runs (pads stripped); host access materializes
+      lazily via ``ctx._materialize_state()``;
+    * ``state_on_device`` — whether ``state`` arrays are device
+      arrays (vs host numpy);
+    * ``cur_step`` — the next step index a ``run_solution`` continues
+      from (var element APIs resolve ring slots against it);
+    * ``steps_done`` — steps accumulated since the last
+      ``clear_stats`` (the stats denominator);
+    * ``run_timer`` / ``halo_timer`` — elapsed wall-clock accounting
+      (compile and halo calibration stay excluded, as before).
+    """
+
+    def __init__(self):
+        self.state: Optional[Dict[str, List]] = None
+        self.resident: Optional[Dict[str, List]] = None
+        self.state_on_device = False
+        self.cur_step = 0
+        self.steps_done = 0
+        self.run_timer = YaskTimer()
+        self.halo_timer = YaskTimer()
+
+    def reset(self) -> None:
+        """Back to the just-prepared shape (timers/step counters keep
+        accumulating — ``clear_stats`` is the explicit reset, exactly
+        as on the pre-hoist context)."""
+        self.state = None
+        self.resident = None
+        self.state_on_device = False
+        self.cur_step = 0
+
+    def __repr__(self):
+        return (f"<RunState step={self.cur_step} "
+                f"alloc={self.state is not None} "
+                f"resident={self.resident is not None}>")

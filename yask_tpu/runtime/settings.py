@@ -146,6 +146,15 @@ class KernelSettings:
         # VMEM-OOM class).  Findings print; the launch proceeds (a
         # checker false-positive must not cost a hardware window).
         self.preflight = True
+        # Ensemble batching (yask_tpu/runtime/ensemble.py): run N
+        # independent instances of the solution as ONE vmapped program
+        # — state rings gain a leading batch dim, so N parameter-sweep
+        # members share a single compile and saturate the chip on
+        # small domains.  Only the single-device modes (jit/pallas)
+        # batch; sharded modes decline with a structured reason
+        # (ensemble_feasible — the checker's ENSEMBLE-INFEASIBLE rule
+        # reads the same definition).  1 = off.
+        self.ensemble = 1
         # Misc.
         self.max_threads = 0           # accepted for parity; XLA manages
         self.numa_pref = -1            # accepted for parity
@@ -245,6 +254,10 @@ class KernelSettings:
             "before launching in the driver tools; findings print, "
             "the launch proceeds (-no-preflight to skip).",
             self, "preflight")
+        parser.add_int_option(
+            "ensemble", "Batch N independent solution instances as one "
+            "vmapped program (jit/pallas single-device modes; sharded "
+            "modes decline).  1 = off.", self, "ensemble")
         parser.add_int_option(
             "max_threads", "Accepted for reference parity.", self,
             "max_threads")
