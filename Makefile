@@ -73,8 +73,10 @@ perfcheck: lint
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) tools/perfcheck.py
 
 # the resilience layer end-to-end on the CPU mesh: fault taxonomy /
-# guards / journal units plus the injected relay-drop resume and
-# all-zero quarantine acceptance paths (see docs/resilience.md)
+# guards / journal / checkpoint units plus the acceptance paths —
+# injected relay-drop resume, all-zero quarantine, SIGKILL-mid-run
+# kill-resume (same-mode and cross-mode restore), and the injected
+# device-hang pallas → jit degradation ladder (see docs/resilience.md)
 faultcheck: lint
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m pytest \
 		tests/test_resilience.py -q

@@ -192,6 +192,41 @@ def measure(ctx, g, steps_per_trial, trials, sanity=None):
     return rates[len(rates) // 2]
 
 
+def _ckpt_ab(fac, env, g, steps_per_trial, trials, base_rate, platform,
+             ddl):
+    """Checkpoint-cadence overhead A/B on the jit headline config: the
+    SAME build re-measured with the supervision cadence on (snapshots
+    to a throwaway dir).  The ratio rides the ledger under the
+    sentinel, so a hot-path regression — ``-ckpt_every 0`` must stay a
+    true no-op, and the cadence cost is one device→host snapshot pull
+    per N steps — is caught in the artifact, never the contract line
+    (the caller isolates this whole probe)."""
+    import tempfile
+    from yask_tpu.perflab import capture_provenance
+    from yask_tpu.perflab.sentinel import guard_and_append
+    with tempfile.TemporaryDirectory(prefix="yt_ckpt_ab_") as td:
+        ctx = build(fac, env, g, "jit")
+        o = ctx.get_settings()
+        o.ckpt_every = max(1, steps_per_trial // 2)
+        o.ckpt_dir = td
+        rate = guarded_call(measure, ctx, g, steps_per_trial, trials,
+                            site="bench.ckpt_ab", deadline_secs=ddl)
+        cadence = o.ckpt_every
+        del ctx
+    overhead = max(0.0, 1.0 - rate / base_rate) if base_rate > 0 else 0.0
+    prov = capture_provenance(
+        platform=platform,
+        device_kind=(getattr(env.get_devices()[0], "device_kind", "")
+                     if env.get_devices() else ""))
+    guard_and_append(
+        f"iso3dfd r=8 {g}^3 fp32 {platform} jit ckpt-cadence A/B",
+        round(rate, 3), "GPts/s", platform, "bench", prov,
+        extra={"ckpt_every": cadence,
+               "baseline_gpts": round(base_rate, 3),
+               "overhead_frac": round(overhead, 4)})
+    return overhead
+
+
 def try_pallas(fac, env, g, steps_per_trial, trials, candidates=(2, 4)):
     """Validated + timed fused-Pallas attempt; returns (rate, K) or None."""
     best = None
@@ -313,6 +348,14 @@ def main():
             compile_ms = round(ctx._compile_secs * 1000.0, 1)
             cache_hit = ctx._last_cache_hit or "cold"
             del ctx
+            # checkpoint-cadence overhead A/B (acceptance: ≤5% on the
+            # jit headline); telemetry only — never the contract line
+            try:
+                _ckpt_ab(fac, env, g, steps_per_trial, trials, rate,
+                         platform, ddl)
+            except Exception as e:  # noqa: BLE001
+                print(f"bench: ckpt A/B failed ({str(e)[:120]})",
+                      file=sys.stderr)
             # interpret-mode Pallas can never beat XLA off-TPU: only try
             # the fused path on real hardware (override via env for tests)
             want_pallas = os.environ.get(

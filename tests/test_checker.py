@@ -229,6 +229,46 @@ def test_cache_pass_silent_without_cache_dir(monkeypatch):
     assert rep.diagnostics == [] and rep.passes == ["cache"]
 
 
+def test_ckpt_pass_silent_when_supervision_off(monkeypatch):
+    # -ckpt_every 0 is a true no-op: no knobs, no diagnostics
+    monkeypatch.delenv("YT_CKPT_DIR", raising=False)
+    ctx = build_ctx(args="-g 32")
+    rep = run_checks(ctx, passes=["ckpt"])
+    assert rep.diagnostics == [] and rep.passes == ["ckpt"]
+
+
+def test_ckpt_dir_cadence_and_ladder_rules(monkeypatch, tmp_path):
+    monkeypatch.delenv("YT_CKPT_DIR", raising=False)
+    # cadence 3 splits the K=2 fused groups; no dir resolves
+    ctx = build_ctx(args="-g 48 -mode pallas -wf_steps 2 -ckpt_every 3")
+    rep = run_checks(ctx, passes=["ckpt"])
+    assert {"CKPT-DIR", "CKPT-CADENCE", "CKPT-LADDER"} <= rules(rep)
+    assert rep.ok()   # both findings are warnings
+    lad = next(d for d in rep.diagnostics if d.rule == "CKPT-LADDER")
+    assert lad.detail["ladder"] == ["jit"]
+    # a writable dir + K-aligned cadence: only the ladder note remains
+    ctx2 = build_ctx(args="-g 48 -mode pallas -wf_steps 2 -ckpt_every 4"
+                     f" -ckpt_dir {tmp_path}")
+    rep2 = run_checks(ctx2, passes=["ckpt"])
+    assert rules(rep2) == {"CKPT-LADDER"}
+
+
+def test_ckpt_unwritable_dir_is_error(tmp_path, monkeypatch):
+    # root ignores permission bits, so force the access answer instead
+    # of chmod-ing a fixture dir
+    import os
+    ctx = build_ctx(args=f"-g 32 -ckpt_every 2 -ckpt_dir {tmp_path}")
+    monkeypatch.setattr(os, "access", lambda p, m: False)
+    rep = run_checks(ctx, passes=["ckpt"])
+    assert "CKPT-DIR" in {d.rule for d in rep.errors}
+
+
+def test_ckpt_deadline_without_cadence_warns():
+    ctx = build_ctx(args="-g 32 -run_deadline 60")
+    rep = run_checks(ctx, passes=["ckpt"])
+    assert "CKPT-DEADLINE" in {d.rule for d in rep.warnings}
+
+
 # ---- the round-3 regression shape -----------------------------------------
 
 def test_round3_vmem_spill_oom_flagged_statically():

@@ -468,3 +468,33 @@ def test_resident_fill_apis_without_materialization(env):
     sp = drive("shard_map", ranks=[("x", 4)])
     assert sp._resident is not None and sp._state is None
     assert sp.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+@pytest.mark.parametrize("src,dst", [
+    ("jit", "shard_map"),
+    ("shard_map", "jit"),
+    ("jit", "pallas"),
+    ("pallas", "shard_map"),
+])
+def test_checkpoint_portable_across_modes(env, ssg_ref, src, dst,
+                                          tmp_path):
+    """Interior-coordinate checkpoints are mode-portable: run 2 steps
+    under one mode, checkpoint, restore into a differently-padded /
+    sharded context, finish the remaining 2 steps there — and the mixed
+    run is identical to the 4-step oracle (ghost zeros + interior fills
+    are mode-invariant, so a snapshot carries the whole simulation)."""
+    from yask_tpu.resilience import restore_checkpoint, save_checkpoint
+
+    def build(mode, spans):
+        ranks = [("x", 4)] if mode == "shard_map" else ()
+        wf = 2 if mode == "pallas" else 0
+        return make_ssg(env, mode, ranks=ranks, wf=wf, spans=spans)
+
+    a = build(src, spans=((0, 1),))
+    path = str(tmp_path / "ssg.ckpt.npz")
+    save_checkpoint(a, path)
+    b = build(dst, spans=())
+    assert restore_checkpoint(b, path)
+    assert b._cur_step == 2
+    b.run_solution(2, 3)
+    assert b.compare_data(ssg_ref) == 0

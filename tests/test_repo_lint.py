@@ -212,6 +212,40 @@ def test_bare_device_call_pragma(tmp_path):
     assert fs == []
 
 
+def test_ckpt_unguarded_fires_in_driver_scope(tmp_path):
+    src = """\
+        def main(ctx, path):
+            save_checkpoint(ctx, path)
+    """
+    assert fired(lint_tool(tmp_path, src)) == ["CKPT-UNGUARDED"]
+    assert fired(lint_tool(tmp_path, src, name="bench.py")) \
+        == ["CKPT-UNGUARDED"]
+    # library / test code is out of scope, same as BARE-DEVICE-CALL
+    assert fired(lint_tool(tmp_path, src, name="yask_tpu/x.py")) == []
+
+
+def test_ckpt_unguarded_sanctioned_via_guard(tmp_path):
+    # passing the checkpoint fn INTO guarded_call is the sanctioned
+    # shape; a helper invoked from a guard root rides the closure
+    fs = lint_tool(tmp_path, """\
+        def resume(ctx, path):
+            return restore_checkpoint(ctx, path)
+
+        def main(ctx, path):
+            guarded_call(save_checkpoint, ctx, path, site="ckpt.save")
+            guarded_call(resume, ctx, path, site="ckpt.restore")
+    """)
+    assert fs == []
+
+
+def test_ckpt_unguarded_pragma(tmp_path):
+    fs = lint_tool(tmp_path, """\
+        def main(ctx, path):
+            restore_checkpoint(ctx, path)  # lint: ckpt-unguarded-ok
+    """)
+    assert fs == []
+
+
 def test_compile_direct_fires_on_chain(tmp_path):
     fs = lint_src(tmp_path, """\
         import jax

@@ -17,15 +17,21 @@ import time
 
 import pytest
 
-from yask_tpu.resilience import (Breaker, CompileFailed, CompilerOOM,
-                                 DeviceHang, Fault, RelayDown,
+from yask_tpu.resilience import (CKPT_SCHEMA, Breaker, CompileFailed,
+                                 CompilerOOM, DeviceHang, Fault,
+                                 RelayDown, ResultAnomaly,
                                  SessionJournal, TERMINAL_OUTCOMES,
                                  anomaly_fields, array_stats,
                                  check_output, classify,
                                  classify_message, deadline,
+                                 default_breaker_path,
+                                 degradation_ladder, extract_snapshot,
                                  fault_point, guarded_call,
-                                 maybe_corrupt, python_cmd,
-                                 reset_faults, run_deadlined)
+                                 max_journal_bytes, maybe_corrupt,
+                                 peek_checkpoint, python_cmd,
+                                 reset_faults, restore_checkpoint,
+                                 run_deadlined, save_checkpoint,
+                                 snapshot_mismatches)
 from yask_tpu.resilience import watch
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -330,6 +336,9 @@ def _session_env(tmp_path, **extra):
         "YT_SESSION_JOURNAL": str(tmp_path / "JOURNAL.jsonl"),
         "YT_TPU_RESULTS": str(tmp_path / "TPU_RESULTS.jsonl"),
         "YT_PERF_LEDGER": str(tmp_path / "LEDGER.jsonl"),
+        # the session breaker persists at default_breaker_path(); keep
+        # subprocess sessions from littering the repo root
+        "YT_BREAKER_STATE": str(tmp_path / "BREAKER_STATE.json"),
     })
     env.pop("YT_FAULT_PLAN", None)
     env.update(extra)
@@ -401,7 +410,373 @@ def test_acceptance_all_zero_output_quarantined(tmp_path):
     assert "all_zero" in out["detail"]["anomalies"]
 
 
+# ------------------------------------------------------------ checkpoints
+
+def _make_iso(mode, g=16, wf=0, ranks=(), **knobs):
+    """A small prepared iso3dfd context with deterministic interiors —
+    the checkpoint/supervision tests' shared subject (every call with
+    the same ``g`` starts from identical state, whatever the mode)."""
+    import numpy as np
+    from yask_tpu import yk_factory
+    fac = yk_factory()
+    env = fac.new_env()
+    ctx = fac.new_solution(env, stencil="iso3dfd", radius=2)
+    ctx.apply_command_line_options(f"-g {g} -wf_steps {wf}")
+    o = ctx.get_settings()
+    o.mode = mode
+    for k, v in knobs.items():
+        setattr(o, k, v)
+    for d, n in ranks:
+        ctx.set_num_ranks(d, n)
+    ctx.prepare_solution()
+    rng = np.random.RandomState(11)
+    for vn in ctx.get_var_names():
+        v = ctx.get_var(vn)
+        if vn == "vel":
+            v.set_all_elements_same(0.05)
+        else:
+            arr = rng.rand(g, g, g).astype(np.float32)
+            v.set_elements_in_slice(arr, [0, 0, 0, 0],
+                                    [0, g - 1, g - 1, g - 1])
+    return ctx
+
+
+def test_ckpt_roundtrip_and_peek(tmp_path):
+    ctx = _make_iso("jit")
+    ctx.run_solution(0, 3)
+    snap = extract_snapshot(ctx)
+    assert snap["meta"]["schema"] == CKPT_SCHEMA
+    assert snap["meta"]["cur_step"] == 4
+    path = str(tmp_path / "c.ckpt.npz")
+    save_checkpoint(ctx, path)
+    meta = peek_checkpoint(path)
+    assert meta and meta["cur_step"] == 4 \
+        and meta["solution"] == "iso3dfd"
+    fresh = _make_iso("jit")                  # different initial state
+    assert restore_checkpoint(fresh, path)
+    assert fresh._cur_step == 4 and fresh._steps_done == 4
+    assert snapshot_mismatches(extract_snapshot(fresh), snap) == 0
+
+
+def test_ckpt_restore_never_raises(tmp_path):
+    """Missing / torn / corrupt / stale-schema / wrong-geometry files
+    all answer False — the caller's fallback is a fresh run, never a
+    crash (the ISSUE's torn-write criterion)."""
+    import numpy as np
+    ctx = _make_iso("jit")
+    ctx.run_solution(0, 1)
+    path = str(tmp_path / "c.ckpt.npz")
+    save_checkpoint(ctx, path)
+
+    assert not restore_checkpoint(ctx, str(tmp_path / "missing.npz"))
+
+    blob = open(path, "rb").read()
+    torn = str(tmp_path / "torn.npz")
+    with open(torn, "wb") as f:
+        f.write(blob[:len(blob) // 2])        # killed mid-write
+    assert not restore_checkpoint(ctx, torn)
+
+    garbage = str(tmp_path / "garbage.npz")
+    with open(garbage, "wb") as f:
+        f.write(b"this is not an npz archive")
+    assert not restore_checkpoint(ctx, garbage)
+
+    stale = str(tmp_path / "stale.npz")
+    data = dict(np.load(path))
+    meta = json.loads(bytes(data["__meta__"]).decode())
+    meta["schema"] = "yask_tpu.checkpoint/0"
+    data["__meta__"] = np.frombuffer(json.dumps(meta).encode(),
+                                     np.uint8)
+    np.savez(stale, **data)
+    assert peek_checkpoint(stale) is None
+    assert not restore_checkpoint(ctx, stale)
+
+    other = _make_iso("jit", g=24)            # wrong domain geometry
+    assert not restore_checkpoint(other, path)
+    assert restore_checkpoint(ctx, path)      # the original still loads
+
+
+def test_ckpt_fault_sites_and_atomicity(monkeypatch, tmp_path):
+    ctx = _make_iso("jit")
+    ctx.run_solution(0, 1)
+    path = str(tmp_path / "c.ckpt.npz")
+    save_checkpoint(ctx, path)
+    good = open(path, "rb").read()
+    monkeypatch.setenv(
+        "YT_FAULT_PLAN",
+        "ckpt.save:relay_down:1; ckpt.restore:device_hang:1")
+    reset_faults()
+    with pytest.raises(RelayDown):
+        save_checkpoint(ctx, path)
+    # the failed save never touched the previous complete checkpoint
+    assert open(path, "rb").read() == good
+    with pytest.raises(DeviceHang):
+        restore_checkpoint(ctx, path)
+    assert restore_checkpoint(ctx, path)      # window exhausted
+
+
+def test_degradation_ladder_table():
+    assert degradation_ladder("shard_pallas") == ["shard_map", "jit"]
+    assert degradation_ladder("shard_map") == ["jit"]
+    assert degradation_ladder("pallas") == ["jit"]
+    assert degradation_ladder("jit") == []
+    assert degradation_ladder("ref") == []    # oracle never degrades
+
+
+# ----------------------------------------------------- breaker sidecar
+
+def test_breaker_persists_across_restarts(tmp_path):
+    path = str(tmp_path / "BREAKER_STATE.json")
+    b = Breaker(threshold=3, path=path)
+    b.record(RelayDown("one"))
+    b.record(RelayDown("two"))
+    b2 = Breaker(threshold=3, path=path)      # a tpu_watch restart
+    assert b2.consecutive == 2 and not b2.tripped
+    assert b2.record(RelayDown("three")) and b2.tripped
+    b3 = Breaker(threshold=3, path=path)      # restart with it open
+    assert b3.tripped and b3.last.kind == "relay_down"
+    b3.reset()                                # a fresh successful probe
+    assert not Breaker(threshold=3, path=path).tripped
+
+
+def test_breaker_sidecar_failures_swallowed(tmp_path):
+    bad = str(tmp_path / "nodir" / "B.json")  # unwritable location
+    b = Breaker(threshold=2, path=bad)        # load failure: silent
+    assert b.record(RelayDown("x")) is False  # persist failure: silent
+    assert b.consecutive == 1
+
+
+def test_default_breaker_path_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("YT_BREAKER_STATE", str(tmp_path / "B.json"))
+    assert default_breaker_path() == str(tmp_path / "B.json")
+
+
+# ------------------------------------------------- journal growth bound
+
+def test_journal_compact_if_large(tmp_path):
+    j = SessionJournal(str(tmp_path / "J.jsonl"))
+    for _ in range(10):
+        j.record("validate", "a", "started")
+        j.record("validate", "a", "ok")
+    assert j.compact_if_large(max_bytes=1 << 20) == 0   # under the bound
+    assert len(j.rows()) == 20
+    dropped = j.compact_if_large(max_bytes=64)
+    assert dropped == 19
+    assert [r["outcome"] for r in j.rows()] == ["ok"]
+    # a missing journal is trivially under any bound
+    assert SessionJournal(
+        str(tmp_path / "none.jsonl")).compact_if_large(max_bytes=1) == 0
+
+
+def test_max_journal_bytes_env(monkeypatch):
+    assert max_journal_bytes() == 8 * 2 ** 20
+    monkeypatch.setenv("YT_JOURNAL_MAX_BYTES", "123")
+    assert max_journal_bytes() == 123
+    monkeypatch.setenv("YT_JOURNAL_MAX_BYTES", "bogus")
+    assert max_journal_bytes() == 8 * 2 ** 20
+
+
+# --------------------------------------------- supervised runs / ladder
+
+def test_supervised_run_matches_plain(tmp_path, monkeypatch):
+    monkeypatch.setenv("YT_SESSION_JOURNAL", str(tmp_path / "J.jsonl"))
+    plain = _make_iso("jit")
+    plain.run_solution(0, 7)
+    sup = _make_iso("jit", ckpt_every=3, watchdog_every=4,
+                    ckpt_dir=str(tmp_path))
+    sup.run_solution(0, 7)
+    assert sup.compare_data(plain) == 0
+    meta = peek_checkpoint(str(tmp_path / "iso3dfd.ckpt.npz"))
+    assert meta and meta["cur_step"] == 8 and meta["steps_done"] == 8
+
+
+def test_watchdog_flags_corrupt_state(tmp_path, monkeypatch):
+    monkeypatch.setenv("YT_SESSION_JOURNAL", str(tmp_path / "J.jsonl"))
+    monkeypatch.setenv("YT_FAULT_PLAN", "run.scan:nan_output:1")
+    reset_faults()
+    ctx = _make_iso("jit", watchdog_every=2)
+    with pytest.raises(ResultAnomaly):        # jit has no rung below it
+        ctx.run_solution(0, 3)
+    rows = SessionJournal(str(tmp_path / "J.jsonl")).rows()
+    flt = [r for r in rows
+           if r["stage"] == "run" and r["outcome"] == "fault"]
+    assert flt and flt[-1]["detail"]["site"] == "run.scan"
+    assert flt[-1]["detail"]["kind"] == "result_anomaly"
+
+
+def test_acceptance_pallas_degrades_to_jit_ladder(tmp_path, monkeypatch):
+    """Injected device hang mid-run under pallas: the supervisor rolls
+    back to the last snapshot, degrades pallas → jit, and finishes with
+    output identical to an uninterrupted jit run (the ISSUE acceptance
+    criterion), with rollback step / ladder path / attempts journaled."""
+    monkeypatch.setenv("YT_SESSION_JOURNAL", str(tmp_path / "J.jsonl"))
+    monkeypatch.setenv("YT_FAULT_PLAN", "run.chunk:device_hang:1:1")
+    reset_faults()
+    ref = _make_iso("jit")
+    ref.run_solution(0, 7)
+    ctx = _make_iso("pallas", wf=2, ckpt_every=2)
+    ctx.run_solution(0, 7)
+    assert ctx._mode == "jit"
+    assert ctx.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4) == 0
+    rows = SessionJournal(str(tmp_path / "J.jsonl")).rows()
+    flt = [r for r in rows
+           if r["stage"] == "run" and r["outcome"] == "fault"]
+    assert len(flt) == 1
+    d = flt[0]["detail"]
+    assert d["kind"] == "device_hang" and d["site"] == "run.chunk"
+    assert d["rollback_step"] == 2 and d["from_mode"] == "pallas"
+    ok = [r for r in rows
+          if r["stage"] == "run" and r["outcome"] == "ok"]
+    assert ok and ok[-1]["detail"] == {
+        "from_mode": "pallas", "final_mode": "jit",
+        "ladder_path": ["jit"], "attempts": 2}
+
+
+_CHILD = """\
+import os, sys
+sys.path.insert(0, os.environ["YT_REPO_ROOT"])
+import numpy as np
+from yask_tpu import yk_factory
+from yask_tpu.resilience import restore_checkpoint, save_checkpoint
+
+mode, out_npz = sys.argv[1], sys.argv[2]
+fac = yk_factory()
+env = fac.new_env()
+ctx = fac.new_solution(env, stencil="iso3dfd", radius=2)
+ctx.apply_command_line_options("-g 16")
+o = ctx.get_settings()
+o.mode = mode
+o.ckpt_every = 2
+o.ckpt_dir = os.environ["YT_CKPT_DIR"]
+if mode == "shard_map":
+    ctx.set_num_ranks("x", 4)
+ctx.prepare_solution()
+# identical to _make_iso(g=16): resumes and twins start from one state
+rng = np.random.RandomState(11)
+for vn in ctx.get_var_names():
+    v = ctx.get_var(vn)
+    if vn == "vel":
+        v.set_all_elements_same(0.05)
+    else:
+        arr = rng.rand(16, 16, 16).astype(np.float32)
+        v.set_elements_in_slice(arr, [0, 0, 0, 0], [0, 15, 15, 15])
+first = 0
+path = os.path.join(o.ckpt_dir, "iso3dfd.ckpt.npz")
+if restore_checkpoint(ctx, path):
+    first = ctx._cur_step
+    print("resumed-at", first, flush=True)
+if first <= 7:
+    ctx.run_solution(first, 7)
+save_checkpoint(ctx, out_npz)
+print("child-done", flush=True)
+"""
+
+
+def test_acceptance_sigkill_resume_bit_identical(tmp_path):
+    """SIGKILL a checkpointing run mid-span; fresh processes restore
+    from the surviving checkpoint and finish bit-identical to an
+    uninterrupted twin — same-mode (jit → jit) AND cross-mode (the
+    checkpoint was written under jit, resumed under shard_map): the
+    ISSUE's kill-resume acceptance criterion."""
+    import shutil
+    import signal
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    kill_dir = tmp_path / "ckpt_kill"
+    env = dict(os.environ)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "YT_REPO_ROOT": ROOT,
+        "YT_CKPT_DIR": str(kill_dir),
+        "YT_SESSION_JOURNAL": str(tmp_path / "J.jsonl"),
+        "YT_BREAKER_STATE": str(tmp_path / "B.json"),
+        # hang the 3rd chunk (after the step-4 cadence save) for 600 s:
+        # the child CANNOT finish on its own — only the SIGKILL ends it
+        "YT_FAULT_PLAN": json.dumps(
+            [{"site": "run.chunk", "kind": "hang", "times": 1,
+              "after": 2, "secs": 600}]),
+    })
+    proc = subprocess.Popen(
+        [sys.executable, str(script), "jit",
+         str(tmp_path / "unused.npz")],
+        env=env, cwd=ROOT, start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    ckpt = str(kill_dir / "iso3dfd.ckpt.npz")
+    try:
+        deadline_t = time.time() + 240
+        while time.time() < deadline_t:
+            meta = peek_checkpoint(ckpt)
+            if meta and meta["cur_step"] >= 4:
+                break
+            assert proc.poll() is None, \
+                f"child exited early (rc={proc.returncode})"
+            time.sleep(0.2)
+        else:
+            pytest.fail("child never banked the step-4 checkpoint")
+    finally:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait(timeout=30)
+    meta = peek_checkpoint(ckpt)
+    assert meta and meta["cur_step"] == 4     # mid-run state survived
+
+    twin = _make_iso("jit")
+    twin.run_solution(0, 7)
+    want = extract_snapshot(twin)
+
+    env.pop("YT_FAULT_PLAN")
+    for mode in ("jit", "shard_map"):
+        d = tmp_path / f"ckpt_{mode}"
+        shutil.copytree(kill_dir, d)          # each resume gets its own
+        out = tmp_path / f"final_{mode}.npz"
+        e = dict(env)
+        e["YT_CKPT_DIR"] = str(d)
+        r = subprocess.run(
+            [sys.executable, str(script), mode, str(out)],
+            env=e, cwd=ROOT, capture_output=True, text=True,
+            timeout=600)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "resumed-at 4" in r.stdout
+        fresh = _make_iso("jit")
+        assert restore_checkpoint(fresh, str(out))
+        assert fresh._cur_step == 8
+        assert snapshot_mismatches(extract_snapshot(fresh), want) == 0
+
+
 # -------------------------------------------------------- halo-cal flag
+
+def test_timed_median_scaled_rounds():
+    from yask_tpu.parallel.shard_step import timed_median
+    vals = iter([1.0, 1.01, 0.99])
+    med, spread, unstable, reps = timed_median(lambda: next(vals))
+    assert reps == 3 and not unstable and abs(med - 1.0) < 1e-9
+
+    # two outlier rounds, then the scaled 7-sample round settles: every
+    # burned trial is counted, the flag stays down
+    vals = iter([1.0, 1.0, 9.0] * 2 + [1.0] * 7)
+    med, spread, unstable, reps = timed_median(lambda: next(vals))
+    assert reps == 13 and not unstable and med == 1.0
+
+    # wild through the scaled round too: unstable sticks
+    vals = iter([1.0, 1.0, 9.0] * 2 + [1.0] * 6 + [9.0])
+    med, spread, unstable, reps = timed_median(lambda: next(vals))
+    assert reps == 13 and unstable
+
+
+def test_yk_stats_halo_cal_reps():
+    from yask_tpu.runtime.stats import yk_stats
+    st = yk_stats(npts=8, nsteps=1, nreads_pp=1, nwrites_pp=1,
+                  nfpops_pp=1, elapsed=1.0, halo_cal_reps=13)
+    assert st.get_halo_cal_reps() == 13
+    assert "halo-cal-reps: 13" in st.format()
+    st2 = yk_stats(npts=8, nsteps=1, nreads_pp=1, nwrites_pp=1,
+                   nfpops_pp=1, elapsed=1.0)
+    assert st2.get_halo_cal_reps() == 0
+    assert "halo-cal-reps" not in st2.format()
+
 
 def test_yk_stats_halo_cal_unstable_flag():
     from yask_tpu.runtime.stats import yk_stats

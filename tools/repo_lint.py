@@ -42,13 +42,21 @@ Rules (see docs/checking.md for the catalog):
   invokers, so helpers like ``measure`` stay clean without pragmas.
   Library code (``yask_tpu/``) is out of scope — the rule is about
   unattended driver artifacts, not the API.
+* ``CKPT-UNGUARDED`` — checkpoint I/O (``save_checkpoint`` /
+  ``load_checkpoint`` / ``restore_checkpoint``) in a driver artifact
+  outside any resilience guard.  Same mechanics and scope as
+  ``BARE-DEVICE-CALL``: a checkpoint save pulls device state to host
+  (a device hang can strand it) and its fault-injection sites
+  (``ckpt.save`` / ``ckpt.restore``) only classify when the call runs
+  under ``guarded_call``; new run-loops that write checkpoints must
+  route them through a guard.
 
 Detection of "an Expr value" is lexical (this is a linter, not a type
 checker): names ``expr``/``lhs``/``rhs``/``eq``, the ``*_expr``
 suffix, and attribute access ``.lhs`` / ``.rhs``.  Escape hatch: put
 ``# lint: <rule>-ok`` on the flagged line (rule tokens: ``expr-eq``,
 ``expr-key``, ``devices``, ``mesh``, ``compile-direct``,
-``bare-device-call``).
+``bare-device-call``, ``ckpt-unguarded``).
 
 Usage: ``python tools/repo_lint.py [paths...]`` — defaults to the
 repo root; exit 1 when anything fires.
@@ -86,6 +94,11 @@ _DEVICE_WORK = {"run_solution", "block_until_ready", "compare_data",
 #: classified-fault guard, and so does everything it calls
 _GUARD_INVOKERS = {"guarded_call", "run_deadlined", "section",
                    "run_case", "run_stage", "guarded"}
+#: checkpoint I/O in a driver artifact needs the same guarding as
+#: device work: the save pulls device state to host, and the
+#: ckpt.save/ckpt.restore injection sites only classify under a guard
+_CKPT_WORK = {"save_checkpoint", "load_checkpoint",
+              "restore_checkpoint"}
 
 
 def _device_rule_in_scope(relpath: str) -> bool:
@@ -277,7 +290,8 @@ class _DeviceCallPass(ast.NodeVisitor):
     but that is exactly how the driver tools are shaped (nested
     section/case closures handed to ``run_case``/``section``)."""
 
-    def __init__(self):
+    def __init__(self, work=None):
+        self.work = work if work is not None else _DEVICE_WORK
         self.calls: dict = {}      # enclosing func name -> called names
         self.roots: set = set()    # names passed into guard invokers
         self.sites: List[tuple] = []   # (node, enclosing-func stack)
@@ -305,7 +319,7 @@ class _DeviceCallPass(ast.NodeVisitor):
                         # case factory: run_case(st, c, make_body(...))
                         # — the factory's nested body runs guarded
                         self.roots.add(a.func.id)
-            if name in _DEVICE_WORK:
+            if name in self.work:
                 self.sites.append((node, tuple(self._stack)))
         self.generic_visit(node)
 
@@ -322,9 +336,13 @@ class _DeviceCallPass(ast.NodeVisitor):
         return guarded
 
 
-def _lint_device_calls(tree: ast.AST, relpath: str,
-                       lines: List[str]) -> List[dict]:
-    p = _DeviceCallPass()
+def _lint_guarded_work(tree: ast.AST, relpath: str, lines: List[str],
+                       work, rule: str, pragma: str,
+                       message: str) -> List[dict]:
+    """Shared reachability check behind BARE-DEVICE-CALL and
+    CKPT-UNGUARDED: flag direct ``work`` invocations whose enclosing
+    function is not reachable from any guard root."""
+    p = _DeviceCallPass(work=work)
     p.visit(tree)
     guarded = p.guarded_funcs()
     findings = []
@@ -333,17 +351,30 @@ def _lint_device_calls(tree: ast.AST, relpath: str,
             continue
         line = (lines[node.lineno - 1]
                 if node.lineno - 1 < len(lines) else "")
-        if "# lint: bare-device-call-ok" in line:
+        if f"# lint: {pragma}-ok" in line:
             continue
         findings.append({
-            "rule": "BARE-DEVICE-CALL", "path": relpath,
-            "line": node.lineno,
-            "message": (f"device work ({_call_name(node)}) in a driver "
-                        "artifact outside any resilience guard — a "
-                        "dying relay hangs it with nothing to kill it; "
-                        "route through guarded_call/run_deadlined (or "
-                        "a section/run_case wrapper), or pragma a "
-                        "deliberate exception")})
+            "rule": rule, "path": relpath, "line": node.lineno,
+            "message": f"{message.format(name=_call_name(node))}"})
+    return findings
+
+
+def _lint_device_calls(tree: ast.AST, relpath: str,
+                       lines: List[str]) -> List[dict]:
+    findings = _lint_guarded_work(
+        tree, relpath, lines, _DEVICE_WORK, "BARE-DEVICE-CALL",
+        "bare-device-call",
+        "device work ({name}) in a driver artifact outside any "
+        "resilience guard — a dying relay hangs it with nothing to "
+        "kill it; route through guarded_call/run_deadlined (or a "
+        "section/run_case wrapper), or pragma a deliberate exception")
+    findings.extend(_lint_guarded_work(
+        tree, relpath, lines, _CKPT_WORK, "CKPT-UNGUARDED",
+        "ckpt-unguarded",
+        "checkpoint I/O ({name}) in a driver artifact outside any "
+        "resilience guard — the ckpt.save/ckpt.restore fault sites "
+        "only classify under guarded_call; route the save/restore "
+        "through a guard, or pragma a deliberate exception"))
     return findings
 
 

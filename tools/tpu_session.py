@@ -30,9 +30,11 @@ a completed joint tune, and a tuned bench number (VERDICT r3 items
 Every stage is crash-isolated AND journaled (yask_tpu.resilience):
 each case appends its outcome to SESSION_JOURNAL.jsonl the moment it
 is known, ``--resume`` completes only the cases a dropped relay left
-unfinished, a consecutive-fault breaker aborts the session loudly when
-the relay dies mid-run, and every measured row passes the result-
-sanity guards (an all-zero field is banked as a quarantined ANOMALY
+unfinished (and, with ``YT_CKPT_DIR`` set, restarts MID-case from the
+supervision cadence's last checkpoint instead of re-running the whole
+case), a consecutive-fault breaker (persisted across watcher restarts)
+aborts the session loudly when the relay dies mid-run, and every
+measured row passes the result-sanity guards (an all-zero field is banked as a quarantined ANOMALY
 row, never a clean number — the round-3 quick-matrix incident).
 
 Run: ``python tools/tpu_session.py [-g 512] [--quick] [--resume |
@@ -271,7 +273,18 @@ def main(argv=None) -> int:
                    or os.environ.get("YT_SESSION_BANK") == "1")
 
     journal = SessionJournal(journal_path)
-    runner = SessionRunner(journal, resume, Breaker(threshold=3))
+    # growth bound: month-long watch loops append every probe window;
+    # past YT_JOURNAL_MAX_BYTES (8 MiB default) compact at session open
+    dropped = journal.compact_if_large()
+    if dropped:
+        log("journal", compacted_rows=dropped)
+    # the breaker is PERSISTENT: a tpu_watch.sh restart must not reset
+    # an open breaker (the relay is still dead); watch_loop resets it
+    # on fresh successful-probe evidence
+    from yask_tpu.resilience import default_breaker_path
+    runner = SessionRunner(
+        journal, resume,
+        Breaker(threshold=3, path=default_breaker_path()))
     journal.record("session", "", "started", quick=quick,
                    resume=resume, g=g_bench, stages=stages)
 
@@ -279,10 +292,37 @@ def main(argv=None) -> int:
         return bank_row(plat, env, line, roofline=roofline,
                         sanity=sanity)
 
+    def run_span(ctx, first, last, tag):
+        """Checkpointed span (forward time): with YT_CKPT_DIR set the
+        supervision cadence snapshots every step into a per-case
+        subdirectory, and under ``--resume`` a mid-case checkpoint
+        restores and only the REMAINING steps run — a dropped relay
+        costs the un-checkpointed tail, not the whole case."""
+        base = os.environ.get("YT_CKPT_DIR", "")
+        if not base:
+            ctx.run_solution(first, last)
+            return
+        o = ctx.get_settings()
+        o.ckpt_dir = os.path.join(base, tag.replace("/", "_"))
+        if o.ckpt_every <= 0:
+            o.ckpt_every = 1   # session cases are short; per-step
+        if resume:
+            from yask_tpu.resilience import restore_checkpoint
+            path = os.path.join(o.ckpt_dir,
+                                f"{ctx.get_name()}.ckpt.npz")
+            if guarded_call(restore_checkpoint, ctx, path,
+                            site="ckpt.restore"):
+                done = ctx._cur_step   # next step the run continues at
+                log("ckpt", case=tag, resumed_at=int(done))
+                if done > last:
+                    return
+                first = max(first, done)
+        ctx.run_solution(first, last)
+
     # 1) smoke
     def smoke():
         ctx = build(fac, env, "iso3dfd", "jit", 128, 2)
-        ctx.run_solution(0, 4)
+        run_span(ctx, 0, 4, "smoke")
         log("smoke", ok=True)
 
     def run_matrix():
@@ -295,7 +335,7 @@ def main(argv=None) -> int:
         def one_case(name, radius):
             def body():
                 ref = build(fac, env, name, "jit", 32, radius)
-                ref.run_solution(0, 3)
+                run_span(ref, 0, 3, f"validate.{name}.ref")
                 # oracle-sanity: an all-zero reference makes every
                 # comparison vacuous (zero stays zero under the linear
                 # homogeneous stencils) — the round-3 all-zero matrix
@@ -308,7 +348,7 @@ def main(argv=None) -> int:
                 for wf in (1, 2):
                     p = build(fac, env, name, "pallas", 32, radius,
                               wf=wf)
-                    p.run_solution(0, 3)
+                    run_span(p, 0, 3, f"validate.{name}.K{wf}")
                     verdict = check_output(
                         maybe_corrupt("session.validate.result",
                                       interior_slice(p)))

@@ -16,6 +16,9 @@ geometry) and emits structured diagnostics.  Five passes:
 * ``cache``       — persistent compile-cache hygiene (stale/corrupt
                     entry scan) and ensemble-batching feasibility for
                     the configured mode;
+* ``ckpt``        — supervised-run configuration (checkpoint cadence vs
+                    deadline budget, writable snapshot dir, fused
+                    K-group alignment, restore-compat ladder proof);
 * ``explain``     — every pallas/skew/pipelining decision and fallback
                     as a structured reason.
 
@@ -38,7 +41,8 @@ from yask_tpu.utils.exceptions import YaskException
 __all__ = ["CheckReport", "Diagnostic", "SCHEMA", "run_checks",
            "preflight"]
 
-PASSES = ("mosaic", "vmem", "races", "distributed", "cache", "explain")
+PASSES = ("mosaic", "vmem", "races", "distributed", "cache", "ckpt",
+          "explain")
 
 
 def _dtype_name(dt) -> str:
@@ -109,6 +113,11 @@ def run_checks(ctx, passes=None) -> CheckReport:
     if "cache" in want:
         from yask_tpu.checker.cache_pass import check_cache
         check_cache(report, ctx)
+    # ckpt pass is plan-free too: cadence/deadline/dir arithmetic over
+    # the settings + the mode-degradation ladder
+    if "ckpt" in want:
+        from yask_tpu.checker.ckpt_pass import check_ckpt
+        check_ckpt(report, ctx)
 
     if program is not None:
         if "mosaic" in want:

@@ -1,0 +1,95 @@
+"""Checkpoint/supervision pass: static checks over the ``-ckpt_every``
+/ ``-watchdog_every`` / ``-run_deadline`` knobs, without executing.
+
+Rules (catalog in ``docs/checking.md``):
+
+* ``CKPT-DIR`` — cadence is on but no checkpoint directory resolves
+  (``-ckpt_dir`` empty and ``YT_CKPT_DIR`` unset): the in-memory
+  rollback still works, but a killed process cannot kill-resume
+  (warn); or the resolved directory cannot be created/written (error).
+* ``CKPT-CADENCE`` — the cadence splits fused K-groups
+  (``ckpt_every % wf_steps != 0``): every supervised chunk boundary
+  forces a remainder group, so the cadence should be a multiple of the
+  fusion depth (warn).
+* ``CKPT-DEADLINE`` — a heartbeat deadline is set with no checkpoint
+  cadence: the deadline then spans the WHOLE run in one chunk, and a
+  trip loses everything back to the entry snapshot (warn).
+* ``CKPT-LADDER`` — the restore-compat/ladder note (info): the
+  degradation ladder the supervision loop would walk from the
+  configured mode, and why cross-mode restore is sound (ring depths,
+  interior geometry, and dtype derive from the solution analysis, not
+  the mode — the checkpoint stores interiors only, and pads are
+  identically zero in every mode).
+
+Pure host work: settings + environment only, no plan needed.
+"""
+
+from __future__ import annotations
+
+import os
+
+from yask_tpu.checker.diagnostics import CheckReport
+
+PASS = "ckpt"
+
+
+def check_ckpt(report: CheckReport, ctx) -> None:
+    report.ran(PASS)
+    opts = ctx._opts
+    cad = int(getattr(opts, "ckpt_every", 0) or 0)
+    wd = int(getattr(opts, "watchdog_every", 0) or 0)
+    ddl = int(getattr(opts, "run_deadline_secs", 0) or 0)
+    if cad <= 0 and wd <= 0 and ddl <= 0:
+        return  # supervision off: -ckpt_every 0 is a true no-op
+
+    from yask_tpu.resilience.checkpoint import (default_ckpt_dir,
+                                                degradation_ladder)
+    mode = getattr(ctx, "_mode", None) or opts.mode
+
+    if cad > 0:
+        d = getattr(opts, "ckpt_dir", "") or default_ckpt_dir()
+        if not d:
+            report.add("CKPT-DIR", "warn",
+                       f"-ckpt_every {cad} with no checkpoint directory "
+                       "(-ckpt_dir / YT_CKPT_DIR): in-memory rollback "
+                       "still works, but a killed process cannot "
+                       "kill-resume from disk",
+                       detail={"ckpt_every": cad})
+        else:
+            probe = d if os.path.isdir(d) else os.path.dirname(
+                os.path.abspath(d)) or "."
+            if not os.access(probe, os.W_OK):
+                report.add("CKPT-DIR", "error",
+                           f"checkpoint directory {d!r} is not writable "
+                           "— every cadence save would fault",
+                           detail={"dir": d})
+
+    wf = int(getattr(opts, "wf_steps", 0) or 0)
+    if cad > 0 and wf > 1 and cad % wf != 0:
+        report.add("CKPT-CADENCE", "warn",
+                   f"-ckpt_every {cad} is not a multiple of wf_steps "
+                   f"{wf}: every supervised chunk boundary splits a "
+                   "fused K-group into remainder groups",
+                   detail={"ckpt_every": cad, "wf_steps": wf})
+
+    if ddl > 0 and cad <= 0 and wd <= 0:
+        report.add("CKPT-DEADLINE", "warn",
+                   f"-run_deadline {ddl}s with neither a checkpoint "
+                   "cadence nor a watchdog: the deadline spans the "
+                   "whole run as ONE chunk, and a trip rolls back to "
+                   "the entry snapshot (step 0 of this run)",
+                   detail={"run_deadline_secs": ddl})
+
+    ladder = degradation_ladder(mode)
+    report.add("CKPT-LADDER", "info",
+               (f"mode '{mode}' degrades via {' → '.join(ladder)} on a "
+                if ladder else
+                f"mode '{mode}' has no degradation ladder (already the "
+                "floor) — a ")
+               + "classified mid-run fault; cross-mode restore is sound "
+               "because checkpoints store interiors only (ring depth, "
+               "interior geometry, and dtype derive from the solution, "
+               "not the mode; pads are identically zero everywhere)",
+               detail={"mode": mode, "ladder": ladder,
+                       "ckpt_every": cad, "watchdog_every": wd,
+                       "run_deadline_secs": ddl})

@@ -259,6 +259,10 @@ def run_harness(argv: Optional[List[str]] = None, out=None) -> int:
                    # is noise — marked, not banked as evidence
                    **({"halo_cal_unstable": True}
                       if st.get_halo_cal_unstable() else {}),
+                   # how many trials the calibration burned (6 = clean;
+                   # more = outlier re-times / the final scaled round)
+                   **({"halo_cal_reps": st.get_halo_cal_reps()}
+                      if st.get_halo_cal_reps() > 0 else {}),
                    # share of the bare collective cost the schedule hid
                    # (the overlapped core/shell split should push this
                    # toward 1; the serial arm shows XLA's baseline)

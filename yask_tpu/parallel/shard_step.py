@@ -500,6 +500,49 @@ def _strip_global_interiors(ctx, gprog, names, mesh, specs_for, gsizes):
     return interior
 
 
+def _is_outlier(samples):
+    """Is the extreme sample an outlier?  The near distance (the
+    spread of the agreeing pair, floored at 2% of the median so two
+    near-identical samples don't declare everything an outlier)
+    sets the scale; an extreme beyond 3× it is rejected."""
+    lo, med, hi = samples[0], samples[len(samples) // 2], samples[-1]
+    if med <= 0:
+        return False
+    d_lo, d_hi = med - lo, hi - med
+    base = max(min(d_lo, d_hi), 0.02 * med)
+    return max(d_lo, d_hi) > 3.0 * base
+
+
+def timed_median(sample, trials=3):
+    """Median of ≥3 independent trials of the zero-arg ``sample``
+    timer + their relative spread ((max−min)/median) + an instability
+    flag + the total rep count.  The halo fraction is a (real − twin)
+    subtraction of two short samples, so a single outlier trial (GC
+    pause, co-tenant burst) lands directly in the reported fraction;
+    the median rejects it, and an extreme beyond 3× the agreeing
+    pair's spread triggers ONE full re-time.  A re-time that is still
+    wild gets one LAST scaled round (2·trials+1 samples — short runs
+    are exactly where per-trial jitter dominates, and a wider sample
+    often settles the median) before the calibration is marked
+    unstable (``halo_cal_unstable`` on the ledger row) instead of
+    banking a noisy split as evidence.  The rep count is recorded so
+    the ledger row says how hard the number was to obtain."""
+    samples = sorted(sample() for _ in range(trials))
+    reps = trials
+    unstable = False
+    if _is_outlier(samples):
+        samples = sorted(sample() for _ in range(trials))
+        reps += trials
+        if _is_outlier(samples):
+            n = 2 * trials + 1
+            samples = sorted(sample() for _ in range(n))
+            reps += n
+            unstable = _is_outlier(samples)
+    med = samples[len(samples) // 2]
+    spread = (samples[-1] - samples[0]) / med if med > 0 else 0.0
+    return med, spread, unstable, reps
+
+
 def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
                          fn_xonly=None, fn_pack=None):
     """Measured halo breakdown for one compiled variant (reference
@@ -555,42 +598,12 @@ def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
                           + int((min_secs - el) / max(per, 1e-9)) + 1)
         return (time.perf_counter() - t0) / calls
 
-    def _is_outlier(samples):
-        """Is the extreme sample an outlier?  The near distance (the
-        spread of the agreeing pair, floored at 2% of the median so two
-        near-identical samples don't declare everything an outlier)
-        sets the scale; an extreme beyond 3× it is rejected."""
-        lo, med, hi = samples[0], samples[len(samples) // 2], samples[-1]
-        if med <= 0:
-            return False
-        d_lo, d_hi = med - lo, hi - med
-        base = max(min(d_lo, d_hi), 0.02 * med)
-        return max(d_lo, d_hi) > 3.0 * base
-
-    def timed_median(f, trials=3):
-        """Median of ≥3 independent timed trials + their relative
-        spread ((max−min)/median) + an instability flag.  The halo
-        fraction is a (real − twin) subtraction of two short samples,
-        so a single outlier trial (GC pause, co-tenant burst) lands
-        directly in the reported fraction; the median rejects it, and
-        an extreme beyond 3× the agreeing pair's spread triggers ONE
-        full re-time — if the fresh trials are just as wild the
-        calibration is marked unstable (``halo_cal_unstable`` on the
-        ledger row) instead of banking a noisy split as evidence."""
-        samples = sorted(timed(f) for _ in range(trials))
-        unstable = False
-        if _is_outlier(samples):
-            samples = sorted(timed(f) for _ in range(trials))
-            unstable = _is_outlier(samples)
-        med = samples[len(samples) // 2]
-        spread = (samples[-1] - samples[0]) / med if med > 0 else 0.0
-        return med, spread, unstable
-
-    t_no, sp_no, un_no = timed_median(fn_no)
-    t_ex, sp_ex, un_ex = timed_median(fn)
+    t_no, sp_no, un_no, rp_no = timed_median(lambda: timed(fn_no))
+    t_ex, sp_ex, un_ex, rp_ex = timed_median(lambda: timed(fn))
     ctx._halo_frac[key] = max(0.0, 1.0 - t_no / t_ex) if t_ex > 0 else 0.0
     ctx._halo_cal_spread[key] = max(sp_no, sp_ex)
     ctx._halo_cal_unstable[key] = bool(un_no or un_ex)
+    ctx._halo_cal_reps[key] = rp_no + rp_ex
     ctx._halo_tcall[key] = t_ex
     if fn_xonly is not None:
         ctx._halo_xround[key] = timed(fn_xonly)
@@ -928,6 +941,7 @@ def run_shard_map(ctx, start: int, n: int) -> None:
         ctx._halo_xpack_last = ctx._halo_xpack.get(key, 0.0)
         ctx._halo_cal_spread_last = ctx._halo_cal_spread.get(key, 0.0)
         ctx._halo_cal_unstable_last = ctx._halo_cal_unstable.get(key, False)
+        ctx._halo_cal_reps_last = ctx._halo_cal_reps.get(key, 0)
         ctx._halo_nperm_last = ctx._halo_nperm.get(key, 0)
         ctx._halo_overlap_eff_last = 0.0   # shard_pallas-only metric
         cal_secs = time.perf_counter() - t0cal
@@ -1459,6 +1473,7 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
         ctx._halo_xpack_last = ctx._halo_xpack.get(key, 0.0)
         ctx._halo_cal_spread_last = ctx._halo_cal_spread.get(key, 0.0)
         ctx._halo_cal_unstable_last = ctx._halo_cal_unstable.get(key, False)
+        ctx._halo_cal_reps_last = ctx._halo_cal_reps.get(key, 0)
         ctx._halo_nperm_last = ctx._halo_nperm.get(key, 0)
         # Overlap efficiency: the serial model pays rounds × bare
         # exchange cost per call; the measured halo cost is frac ×

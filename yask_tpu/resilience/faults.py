@@ -42,8 +42,8 @@ from typing import Dict, List, Optional
 __all__ = [
     "Fault", "RelayDown", "DeviceHang", "CompilerOOM", "CompileFailed",
     "ResultAnomaly", "FAULT_KINDS", "classify", "classify_message",
-    "Breaker", "fault_point", "maybe_corrupt", "reset_faults",
-    "active_plan",
+    "Breaker", "default_breaker_path", "fault_point", "maybe_corrupt",
+    "reset_faults", "active_plan",
 ]
 
 
@@ -142,26 +142,78 @@ def classify(exc: BaseException,
     return f
 
 
+def default_breaker_path() -> str:
+    """Sidecar file for persistent breaker state (``YT_BREAKER_STATE``
+    overrides; default ``BREAKER_STATE.json`` next to the journal)."""
+    explicit = os.environ.get("YT_BREAKER_STATE")
+    if explicit:
+        return explicit
+    from yask_tpu.resilience.journal import repo_root
+    return os.path.join(repo_root(), "BREAKER_STATE.json")
+
+
 class Breaker:
     """Consecutive-failure circuit breaker (the auto-tuner's 3-failure
     rule, hoisted to one shared definition).  ``record`` faults as they
     happen and ``reset`` on any success; once ``tripped``, the caller
     should abort the enclosing walk/session — every further attempt is
-    burning a hardware window against a dead relay."""
+    burning a hardware window against a dead relay.
 
-    def __init__(self, threshold: int = 3):
+    With ``path`` set, state (count + last fault kind) persists to an
+    atomic JSON sidecar and is reloaded on construction, so a
+    ``tpu_watch.sh`` restart does not reset an open breaker and
+    immediately re-burn a relay window.  A fresh successful relay
+    probe is the legitimate reset (the watcher calls ``reset()`` then).
+    Sidecar I/O failures are swallowed: persistence is a convenience,
+    never a new failure mode."""
+
+    def __init__(self, threshold: int = 3, path: Optional[str] = None):
         self.threshold = threshold
+        self.path = path
         self.consecutive = 0
         self.last: Optional[Fault] = None
+        if path:
+            self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as f:
+                d = json.load(f)
+            self.consecutive = max(0, int(d.get("consecutive", 0)))
+            cls = FAULT_KINDS.get(d.get("last_kind", ""))
+            if cls is not None:
+                self.last = cls(str(d.get("last_msg", "")))
+        except (OSError, ValueError, TypeError):
+            pass
+
+    def _persist(self) -> None:
+        if not self.path:
+            return
+        try:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"consecutive": self.consecutive,
+                           "threshold": self.threshold,
+                           "tripped": self.tripped,
+                           "last_kind": getattr(self.last, "kind", None),
+                           "last_msg": (str(self.last)[:200]
+                                        if self.last else ""),
+                           "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                               time.gmtime())}, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass
 
     def record(self, fault: Fault) -> bool:
         """Count one fault; returns whether the breaker is now open."""
         self.consecutive += 1
         self.last = fault
+        self._persist()
         return self.tripped
 
     def reset(self) -> None:
         self.consecutive = 0
+        self._persist()
 
     @property
     def tripped(self) -> bool:

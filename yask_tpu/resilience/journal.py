@@ -38,6 +38,18 @@ JOURNAL_BASENAME = "SESSION_JOURNAL.jsonl"
 #: outcomes after which a case need not rerun.
 TERMINAL_OUTCOMES = ("ok", "anomaly", "skip")
 
+#: growth bound for month-long watch loops (YT_JOURNAL_MAX_BYTES
+#: overrides): past this, session open compacts before appending.
+DEFAULT_MAX_BYTES = 8 * 2 ** 20
+
+
+def max_journal_bytes() -> int:
+    try:
+        return int(os.environ.get("YT_JOURNAL_MAX_BYTES", "")
+                   or DEFAULT_MAX_BYTES)
+    except ValueError:
+        return DEFAULT_MAX_BYTES
+
 
 def repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.dirname(
@@ -150,3 +162,17 @@ class SessionJournal:
                 f.write(json.dumps(row, sort_keys=True) + "\n")
         os.replace(tmp, self.path)
         return len(rows) - len(keep)
+
+    def compact_if_large(self, max_bytes: Optional[int] = None) -> int:
+        """Compact only when the file exceeds the growth bound
+        (``YT_JOURNAL_MAX_BYTES``, default 8 MiB) — the session-open
+        guard that keeps month-long ``tpu_watch`` loops from growing
+        the journal unboundedly.  Returns rows dropped (0 when under
+        the bound or the file is missing)."""
+        limit = max_journal_bytes() if max_bytes is None else max_bytes
+        try:
+            if os.path.getsize(self.path) <= limit:
+                return 0
+        except OSError:
+            return 0
+        return self.compact()

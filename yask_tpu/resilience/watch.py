@@ -133,6 +133,13 @@ def watch_loop(g: int = 512, probe_secs: float = 170.0,
     while True:
         if relay_up():
             windows += 1
+            # a fresh successful probe is the legitimate evidence that
+            # the relay is back: reset the PERSISTENT breaker so the
+            # session isn't strangled by a previous window's open state
+            # (a mere watcher restart, by contrast, keeps it open)
+            from yask_tpu.resilience.faults import (Breaker,
+                                                    default_breaker_path)
+            Breaker(path=default_breaker_path()).reset()
             args = session_args(journal, g=g)
             out.write(f"watch: relay UP — session {windows} "
                       f"({' '.join(args)})\n")

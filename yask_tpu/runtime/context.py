@@ -441,6 +441,7 @@ class StencilContext:
         self._halo_xpack = {}        # key -> secs pack-only (no collective)
         self._halo_cal_spread = {}   # key -> rel spread of the twin trials
         self._halo_cal_unstable = {}  # key -> outliers survived re-time
+        self._halo_cal_reps = {}     # key -> total calibration reps run
         self._halo_tcall = {}        # key -> secs per full timed call
         self._halo_overlap_eff = {}  # key -> hidden collective fraction
         self._halo_nperm = {}        # key -> traced collectives per round
@@ -449,6 +450,7 @@ class StencilContext:
         self._halo_xpack_last = 0.0
         self._halo_cal_spread_last = 0.0
         self._halo_cal_unstable_last = False
+        self._halo_cal_reps_last = 0
         self._halo_overlap_eff_last = 0.0
         for h in self._hooks["after_prepare"]:
             h(self)
@@ -574,6 +576,23 @@ class StencilContext:
             h(self)
         start, n = self._step_seq(first_step_index, last_step_index)
 
+        # Supervised mode: checkpoint cadence / watchdog / deadline knobs
+        # re-enter run_solution per chunk with hooks swapped out, exactly
+        # like trace mode below.  All-zero knobs (the default) make this
+        # three int compares — a true no-op on the hot path.
+        o = self._opts
+        if (o.ckpt_every > 0 or o.watchdog_every > 0
+                or o.run_deadline_secs > 0) \
+                and not getattr(self, "_in_supervised", False):
+            hooks, self._hooks = self._hooks, {k: [] for k in self._hooks}
+            try:
+                self._run_supervised(start, n)
+            finally:
+                self._hooks = hooks
+            for h in self._hooks["after_run"]:
+                h(self)
+            return
+
         # Trace mode: advance one step at a time, dumping written state
         # after each (trace_mem analog). Hooks fire once for the whole
         # span, exactly as untraced.
@@ -639,6 +658,142 @@ class StencilContext:
             for _ in range(n):
                 self._state = prog.step(self._state, t)
                 t += self._ana.step_dir
+
+    # ------------------------------------------------------------------
+    # supervised runs: checkpoint cadence, watchdog, degradation ladder
+    # ------------------------------------------------------------------
+
+    def _run_supervised(self, start: int, n: int) -> None:
+        """Chunked run with checkpoint cadence, per-chunk deadline, a
+        cheap device-state watchdog, and on a classified fault a rollback
+        to the last good snapshot + retry down the mode-degradation
+        ladder (``shard_pallas → shard_map → jit``, ``pallas → jit``).
+
+        Snapshots are interior-coordinate (:mod:`..resilience.checkpoint`)
+        so a rollback taken in one mode restores bit-identically into the
+        next rung.  A LOCAL breaker (recorded manually — chunk successes
+        must not reset it) bounds total degrade attempts; anomalies from
+        the watchdog classify as :class:`ResultAnomaly` and take the same
+        path.  Progress is tracked as ``(last_good, last_done)`` pairs —
+        never inferred from ``_cur_step``."""
+        import os
+        from yask_tpu.resilience import checkpoint as ckpt
+        from yask_tpu.resilience.faults import Breaker, Fault
+        from yask_tpu.resilience.guard import guarded_call
+        from yask_tpu.resilience.journal import SessionJournal
+
+        o = self._opts
+        cad = max(0, int(o.ckpt_every))
+        wd = max(0, int(o.watchdog_every))
+        ddl = float(o.run_deadline_secs) if o.run_deadline_secs > 0 \
+            else None
+        dirn = self._ana.step_dir
+        ckpt_file = None
+        if cad:
+            ckpt_dir = o.ckpt_dir or ckpt.default_ckpt_dir()
+            if ckpt_dir:
+                ckpt_file = os.path.join(
+                    ckpt_dir, f"{self.get_name()}.ckpt.npz")
+
+        def _journal(outcome, attempt, **detail):
+            # best-effort: supervision journaling is evidence, never a
+            # dependency (journal.record raises on I/O failure by
+            # contract — a run must survive a read-only journal dir)
+            try:
+                SessionJournal().record(
+                    "run", case=self.get_name(), outcome=outcome,
+                    attempt=attempt, **detail)
+            except Exception:  # noqa: BLE001
+                pass
+
+        self._in_supervised = True
+        try:
+            last_good = ckpt.extract_snapshot(self)
+            last_done = 0
+            if ckpt_file:
+                guarded_call(ckpt.save_checkpoint, self, ckpt_file,
+                             site="ckpt.save")
+            ladder = ckpt.degradation_ladder(self._mode)
+            from_mode = self._mode
+            breaker = Breaker()
+            ladder_path = []
+            attempt = 1
+            stride = n
+            if cad:
+                stride = min(stride, cad)
+            if wd:
+                stride = min(stride, wd)
+            done = last_done
+            while done < n:
+                k = min(stride, n - done)
+                t0 = start + done * dirn
+                try:
+                    guarded_call(self.run_solution, t0,
+                                 t0 + (k - 1) * dirn,
+                                 site="run.chunk", deadline_secs=ddl)
+                    done += k
+                    # scan BEFORE the cadence snapshot: corrupt state
+                    # must never become the rollback target
+                    if wd and (done >= n or done % wd == 0):
+                        self._watchdog_scan()
+                except Fault as f:
+                    breaker.record(f)
+                    _journal("fault", attempt, kind=f.kind,
+                             site=getattr(f, "site", "run.chunk"),
+                             rollback_step=start + last_done * dirn,
+                             from_mode=self._mode,
+                             ladder=list(ladder))
+                    if breaker.tripped or not ladder:
+                        raise
+                    to_mode = ladder.pop(0)
+                    self._opts.mode = to_mode
+                    self.prepare_solution()
+                    if not ckpt.apply_snapshot(self, last_good):
+                        raise
+                    ladder_path.append(to_mode)
+                    attempt += 1
+                    done = last_done
+                    continue
+                if cad and done < n and done % cad == 0:
+                    last_good = ckpt.extract_snapshot(self)
+                    last_done = done
+                    if ckpt_file:
+                        guarded_call(ckpt.save_checkpoint, self,
+                                     ckpt_file, site="ckpt.save")
+            if ckpt_file:
+                guarded_call(ckpt.save_checkpoint, self, ckpt_file,
+                             site="ckpt.save")
+            if ladder_path:
+                _journal("ok", attempt, from_mode=from_mode,
+                         final_mode=self._mode,
+                         ladder_path=ladder_path, attempts=attempt)
+        finally:
+            self._in_supervised = False
+
+    def _watchdog_scan(self) -> None:
+        """Cheap per-cadence state scan: nonfinite / all-zero written
+        interiors raise :class:`ResultAnomaly` (same thresholds as
+        :mod:`..resilience.sanity`), feeding the supervision ladder."""
+        from yask_tpu.resilience.faults import ResultAnomaly, maybe_corrupt
+        from yask_tpu.resilience.sanity import check_output
+        self._materialize_state()
+        gsz = self._opts.global_domain_sizes
+        arrs = {}
+        for name, g in self._program.geoms.items():
+            if not g.is_written or g.is_scratch:
+                continue
+            idx = tuple(
+                slice(g.origin[dn], g.origin[dn] + gsz[dn])
+                if kind == "domain" else slice(None)
+                for dn, kind in g.axes)
+            arrs[name] = [np.asarray(self._state[name][-1][idx])]
+        arrs = maybe_corrupt("run.scan", arrs)
+        verdict = check_output(arrs)
+        if not verdict["ok"]:
+            raise ResultAnomaly(
+                "watchdog scan flagged written state: "
+                + ", ".join(verdict["anomalies"]),
+                site="run.scan")
 
     def _persistent_key(self, kind: str, **build) -> Tuple:
         """Cross-process cache key for :func:`yask_tpu.cache.aot_compile`.
@@ -1213,6 +1368,7 @@ class StencilContext:
             halo_pack_secs=self._halo_xpack_last,
             halo_cal_spread=self._halo_cal_spread_last,
             halo_cal_unstable=self._halo_cal_unstable_last,
+            halo_cal_reps=getattr(self, "_halo_cal_reps_last", 0),
             halo_overlap_eff=self._halo_overlap_eff_last,
             halo_collectives=getattr(self, "_halo_nperm_last", 0),
             read_bytes_pp=rb_pp, write_bytes_pp=wb_pp,

@@ -45,6 +45,12 @@ class RunState:
       from (var element APIs resolve ring slots against it);
     * ``steps_done`` — steps accumulated since the last
       ``clear_stats`` (the stats denominator);
+
+    A checkpoint restore (``resilience.checkpoint.apply_snapshot``)
+    rewinds ``cur_step``/``steps_done`` to the snapshot's values, but
+    steps a supervised run REDOES after a rollback keep accumulating
+    in ``steps_done`` and ``run_timer`` once re-run — throughput stats
+    honestly charge the redone work instead of hiding it.
     * ``run_timer`` / ``halo_timer`` — elapsed wall-clock accounting
       (compile and halo calibration stay excluded, as before).
     """

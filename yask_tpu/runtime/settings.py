@@ -155,6 +155,19 @@ class KernelSettings:
         # (ensemble_feasible — the checker's ENSEMBLE-INFEASIBLE rule
         # reads the same definition).  1 = off.
         self.ensemble = 1
+        # Supervised runs (yask_tpu/resilience/checkpoint.py): checkpoint
+        # cadence in steps (0 = off — the hot path sees three int
+        # compares and nothing else), snapshot directory (empty = the
+        # YT_CKPT_DIR env; cadence without any dir keeps in-memory
+        # rollback snapshots only), watchdog scan cadence (nonfinite /
+        # all-zero written-interior check every M steps), and a per-chunk
+        # deadline in seconds.  Any nonzero knob routes run_solution
+        # through the supervision loop with its mode-degradation ladder
+        # (shard_pallas → shard_map → jit, pallas → jit).
+        self.ckpt_every = 0
+        self.ckpt_dir = ""
+        self.watchdog_every = 0
+        self.run_deadline_secs = 0
         # Misc.
         self.max_threads = 0           # accepted for parity; XLA manages
         self.numa_pref = -1            # accepted for parity
@@ -258,6 +271,21 @@ class KernelSettings:
             "ensemble", "Batch N independent solution instances as one "
             "vmapped program (jit/pallas single-device modes; sharded "
             "modes decline).  1 = off.", self, "ensemble")
+        parser.add_int_option(
+            "ckpt_every", "Checkpoint the run every N steps (portable "
+            "interior-coordinate snapshots; 0 = off).", self,
+            "ckpt_every")
+        parser.add_string_option(
+            "ckpt_dir", "Directory for on-disk checkpoints (empty = "
+            "YT_CKPT_DIR env; cadence without a dir keeps in-memory "
+            "rollback snapshots only).", self, "ckpt_dir")
+        parser.add_int_option(
+            "watchdog_every", "Scan written state for nonfinite / "
+            "all-zero interiors every M steps (0 = off).", self,
+            "watchdog_every")
+        parser.add_int_option(
+            "run_deadline", "Per-chunk deadline in seconds for "
+            "supervised runs (0 = off).", self, "run_deadline_secs")
         parser.add_int_option(
             "max_threads", "Accepted for reference parity.", self,
             "max_threads")

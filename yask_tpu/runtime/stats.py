@@ -18,6 +18,7 @@ class yk_stats:
                  halo_pack_secs: float = 0.0,
                  halo_cal_spread: float = 0.0,
                  halo_cal_unstable: bool = False,
+                 halo_cal_reps: int = 0,
                  halo_overlap_eff: float = 0.0,
                  halo_collectives: int = 0,
                  read_bytes_pp: float = 0.0, write_bytes_pp: float = 0.0,
@@ -34,6 +35,7 @@ class yk_stats:
         self._halo_xpack = halo_pack_secs
         self._halo_cal_spread = halo_cal_spread
         self._halo_cal_unstable = halo_cal_unstable
+        self._halo_cal_reps = halo_cal_reps
         self._halo_overlap_eff = halo_overlap_eff
         self._halo_collectives = halo_collectives
         self._rb_pp = read_bytes_pp
@@ -124,8 +126,17 @@ class yk_stats:
         reported — the median is the best available estimate — but
         consumers must treat the row as noise, not evidence: the ledger
         marks it ``halo_cal_unstable`` and the sentinel's baseline
-        logic ignores such rows."""
+        logic ignores such rows.  Unstable is only declared after one
+        LAST scaled round (2·trials+1 samples) also failed —
+        :func:`get_halo_cal_reps` says how many were burned."""
         return self._halo_cal_unstable
+
+    def get_halo_cal_reps(self) -> int:
+        """Total calibration trials run across the (real, twin) pair —
+        6 when every round was clean, more when outliers forced
+        re-times / the final scaled round.  0 when no calibration ran
+        (non-shard modes, measure_halo off)."""
+        return self._halo_cal_reps
 
     def get_halo_collectives(self) -> int:
         """Collectives (ppermutes) one full ghost-exchange round issues
@@ -175,6 +186,8 @@ class yk_stats:
                 f"halo-cal-spread (rel): {self._halo_cal_spread:.4g}\n"
                 + ("halo-cal-unstable: true\n"
                    if self._halo_cal_unstable else "")
+                + (f"halo-cal-reps: {self._halo_cal_reps}\n"
+                   if self._halo_cal_reps else "")
                 + f"halo-collective (sec): "
                 f"{self.get_halo_collective_secs():.6g}\n"
                 + (f"halo-collectives-per-round: "
