@@ -7,7 +7,7 @@ TEST_ENV ?= PALLAS_AXON_POOL_IPS=
 
 .PHONY: all native capi test test-fast scratch-tests boundary-tests \
         stages-tests mode-tests bench perfcheck faultcheck commcheck \
-        cachecheck examples clean list-stencils lint check
+        cachecheck servecheck examples clean list-stencils lint check
 
 all: native test
 
@@ -59,10 +59,19 @@ cachecheck: lint
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m pytest \
 		tests/test_cache.py tests/test_ensemble.py -q
 
+# the serving layer end-to-end on the CPU mesh: the multi-tenant
+# acceptance path (two prepared stencils, 8 concurrent tenants,
+# bit-identity + occupancy > 1 + warm-restart zero lowerings), the
+# injected serve.run degradation ladder, sanity quarantine on release,
+# journal schema, and the SERVE-* checker rules (see docs/serving.md)
+servecheck: lint
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_serve.py -q
+
 # static checker over the flagship configs: Mosaic legality, VMEM
 # feasibility (incl. the round-3 spill-OOM class), races, explain.
 # See docs/checking.md; nonzero exit on any error-severity finding.
-check: cachecheck
+check: cachecheck servecheck
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker \
 		-stencil iso3dfd -radius 8 -g 256 -mode pallas -wf_steps 2
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker -all_stencils

@@ -580,6 +580,113 @@ def run_suite(fac, env, budget_secs=None):
              batched_reason=ens.batched_reason)
         del ctx, ens
 
+    def serve_batch_ab():
+        # Serving-layer A/B at the same sweep point as ensemble_ab
+        # (N=8 at 64³ off-TPU): the sequential arm is N fresh solo
+        # contexts each paying its own compile (memo cleared per
+        # member, disk cache off) — the no-server cost of answering N
+        # tenants.  The serve arm is ONE StencilServer: N sessions on
+        # one profile, submit-all-then-wait-all, so the batching
+        # window groups them into one vmapped execution — PLUS the
+        # server's honest overheads (worker handoff, pre-request
+        # snapshots, journal rows, sanity gating).  Correctness gate:
+        # every response bit-identical to its sequential twin's
+        # written interiors.  The SERVE_BATCH_SPEEDUP_FLOOR (1.5×) is
+        # CPU-scoped and deliberately below the 2× ensemble floor:
+        # the serving machinery's per-request tax is part of what this
+        # row tracks.
+        import numpy as np
+        from yask_tpu import cache as ccache
+        from yask_tpu.serve import StencilServer
+        from yask_tpu.serve.scheduler import extract_outputs
+        try:
+            N = int(os.environ.get("YT_BENCH_ENSEMBLE", "8"))
+        except ValueError:
+            N = 8
+        if N < 2:
+            return
+        g = 128 if on_tpu else 64
+
+        def seed_arr(i):
+            rng = np.random.RandomState(1000 + i)
+            return (rng.rand(1, g, g, g).astype(np.float32) - 0.5) * 0.1
+
+        def seq_arm():
+            ctxs = []
+            for i in range(N):
+                ctx = build(fac, env, "iso3dfd", 8, g, "jit")
+                ctx.get_var("pressure").set_elements_in_slice(
+                    seed_arr(i), [0, 0, 0, 0],
+                    [0, g - 1, g - 1, g - 1])
+                ctxs.append(ctx)
+            t0s = time.perf_counter()
+            for ctx in ctxs:
+                ccache.clear_memo()  # N tenants, N compiles — the
+                ctx.run_solution(0, steps - 1)   # cost being beaten
+            t = time.perf_counter() - t0s
+            outs = [extract_outputs(ctx) for ctx in ctxs]
+            del ctxs
+            return t, outs
+
+        def serve_arm():
+            srv = StencilServer(window_secs=0.1, max_batch=N,
+                                preflight=False)
+            sids = []
+            for i in range(N):
+                sid = srv.open_session(stencil="iso3dfd", radius=8,
+                                       g=g, mode="jit", wf=2)
+                srv.init_vars(sid)
+                with srv.scheduler.session_ctx(sid) as c:
+                    c.get_var("pressure").set_elements_in_slice(
+                        seed_arr(i), [0, 0, 0, 0],
+                        [0, g - 1, g - 1, g - 1])
+                sids.append(sid)
+            ccache.clear_memo()
+            t0b = time.perf_counter()
+            handles = [srv.submit_run(sid, 0, steps - 1)
+                       for sid in sids]
+            resps = [srv.wait(h, timeout=600) for h in handles]
+            t = time.perf_counter() - t0b
+            occ = max((r.batch for r in resps), default=0)
+            srv.shutdown()
+            for r in resps:
+                if not r.ok:
+                    raise RuntimeError(
+                        f"serve arm request {r.rid}: {r.status} "
+                        f"{r.error}")
+            return t, resps, occ
+
+        saved = os.environ.pop("YT_COMPILE_CACHE", None)
+        try:
+            t_seq, seq_outs = seq_arm()
+            t_srv, resps, occ = serve_arm()
+        finally:
+            if saved is not None:
+                os.environ["YT_COMPILE_CACHE"] = saved
+        for i, (want, r) in enumerate(zip(seq_outs, resps)):
+            for n, a in want.items():
+                b = r.outputs[n]
+                if not np.array_equal(a, b):
+                    raise RuntimeError(
+                        f"serve tenant {i} var {n} not bit-identical "
+                        "to its sequential twin "
+                        f"(maxdiff {np.abs(a - b).max()})")
+
+        def remeasure_ratio():
+            sv = os.environ.pop("YT_COMPILE_CACHE", None)
+            try:
+                ts, _ = seq_arm()
+                tb, _, _ = serve_arm()
+                return ts / max(tb, 1e-12)
+            finally:
+                if sv is not None:
+                    os.environ["YT_COMPILE_CACHE"] = sv
+
+        emit(f"iso3dfd r=8 {g}^3 {plat} serve-batch{N}-speedup",
+             t_seq / max(t_srv, 1e-12), "x", remeasure=remeasure_ratio,
+             tenants=N, occupancy=occ, seq_secs=round(t_seq, 3),
+             serve_secs=round(t_srv, 3))
+
     # explicit section(...) calls (not a loop over a tuple): repo_lint's
     # BARE-DEVICE-CALL closure sanctions device work lexically, from
     # the names passed into the guard invokers
@@ -594,6 +701,7 @@ def run_suite(fac, env, budget_secs=None):
     section(sm_coalesce, t0, budget_secs)
     section(sp_overlap, t0, budget_secs)
     section(ensemble_ab, t0, budget_secs)
+    section(serve_batch_ab, t0, budget_secs)
     return list(ROWS)
 
 

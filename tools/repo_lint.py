@@ -102,11 +102,15 @@ _CKPT_WORK = {"save_checkpoint", "load_checkpoint",
 
 
 def _device_rule_in_scope(relpath: str) -> bool:
-    """Driver artifacts only: bench.py and the tools/ scripts run
-    unattended against the relay; library code is exercised under the
-    callers' guards."""
+    """Driver artifacts plus the serving layer: bench.py and the
+    tools/ scripts run unattended against the relay, and
+    yask_tpu/serve/ answers tenants long after any human is watching
+    — both must reach device work only through a guard.  Other
+    library code is exercised under the callers' guards."""
     return (relpath == "bench.py"
-            or relpath.startswith("tools" + os.sep))
+            or relpath.startswith("tools" + os.sep)
+            or relpath.startswith(
+                os.path.join("yask_tpu", "serve") + os.sep))
 
 
 def _is_expr_operand(node: ast.AST) -> bool:

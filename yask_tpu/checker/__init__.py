@@ -19,6 +19,9 @@ geometry) and emits structured diagnostics.  Five passes:
 * ``ckpt``        — supervised-run configuration (checkpoint cadence vs
                     deadline budget, writable snapshot dir, fused
                     K-group alignment, restore-compat ladder proof);
+* ``serve``       — server-hosted profile checks (micro-batching
+                    compatibility of the configured mode, compile-cache
+                    warmth for warm restart); gated on ``-serve``;
 * ``explain``     — every pallas/skew/pipelining decision and fallback
                     as a structured reason.
 
@@ -42,7 +45,7 @@ __all__ = ["CheckReport", "Diagnostic", "SCHEMA", "run_checks",
            "preflight"]
 
 PASSES = ("mosaic", "vmem", "races", "distributed", "cache", "ckpt",
-          "explain")
+          "serve", "explain")
 
 
 def _dtype_name(dt) -> str:
@@ -118,6 +121,11 @@ def run_checks(ctx, passes=None) -> CheckReport:
     if "ckpt" in want:
         from yask_tpu.checker.ckpt_pass import check_ckpt
         check_ckpt(report, ctx)
+    # serve pass: batching feasibility + compile-cache warmth for a
+    # server-hosted profile (gated on the -serve knob; plan-free)
+    if "serve" in want:
+        from yask_tpu.checker.serve_pass import check_serve
+        check_serve(report, ctx)
 
     if program is not None:
         if "mosaic" in want:

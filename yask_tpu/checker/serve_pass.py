@@ -1,0 +1,72 @@
+"""Serving pass: static checks over a server-hosted solution profile.
+
+Gated on the ``-serve`` knob (set by ``StencilServer`` on every
+context it prepares, or explicitly for checker runs) exactly like the
+ckpt pass gates on the supervision knobs — a non-serving
+``make check -all_stencils`` stays silent.
+
+Rules (catalog in ``docs/checking.md``):
+
+* ``SERVE-BATCH-INCOMPAT`` — requests against this profile can never
+  co-batch: the configured mode fails
+  :func:`~yask_tpu.runtime.ensemble.ensemble_feasible` (sharded modes
+  decompose state over the mesh; ``ref`` is the sequential oracle).
+  The server still answers — every request just rides an
+  occupancy-1 execution, so the micro-batching window only adds
+  latency (warn).  When the mode batches, an info records the batching
+  identity (mode + pallas-variant key) requests must share to group —
+  two profiles with mismatched variant keys never co-batch even at
+  the same geometry.
+* ``SERVE-CACHE-COLD`` — ``YT_COMPILE_CACHE`` is unset for a server
+  launch: warm restart is the serving layer's availability story (a
+  restarted server answers its first request with zero lowerings),
+  and without the disk cache every restart re-traces and re-lowers
+  every profile (warn).
+
+Pure host work: a mode property and an environment read — no plan,
+no execution.
+"""
+
+from __future__ import annotations
+
+from yask_tpu.checker.diagnostics import CheckReport
+
+PASS = "serve"
+
+
+def check_serve(report: CheckReport, ctx) -> None:
+    report.ran(PASS)
+    opts = ctx._opts
+    if not getattr(opts, "serve", False):
+        return  # not server-hosted: the pass is a true no-op
+
+    from yask_tpu.runtime.ensemble import ensemble_feasible
+    mode = getattr(ctx, "_mode", None) or opts.mode
+    ok, why = ensemble_feasible(ctx)
+    if not ok:
+        report.add("SERVE-BATCH-INCOMPAT", "warn",
+                   f"requests against this profile can never "
+                   f"co-batch: {why} — every request rides an "
+                   "occupancy-1 execution and the batching window "
+                   "only adds latency",
+                   detail={"mode": mode, "reason": why})
+    else:
+        try:
+            variant = list(ctx._pallas_variant_key())
+        except Exception:  # noqa: BLE001 - identity note must not fail
+            variant = []
+        report.add("SERVE-BATCH-INCOMPAT", "info",
+                   f"mode '{mode}' co-batches; requests group on "
+                   "(profile, mode, variant key, step range) — "
+                   "profiles with different variant keys never share "
+                   "a vmapped execution",
+                   detail={"mode": mode, "variant_key": variant})
+
+    from yask_tpu.cache import cache_dir
+    if not cache_dir():
+        report.add("SERVE-CACHE-COLD", "warn",
+                   "YT_COMPILE_CACHE is unset for a server launch: a "
+                   "restarted server re-traces and re-lowers every "
+                   "profile instead of answering its first request "
+                   "from the disk cache with zero lowerings",
+                   detail={"env": "YT_COMPILE_CACHE"})

@@ -139,6 +139,18 @@ TRAP_SPEEDUP_FLOOR = 0.9
 #: the ratio well past the compile-only bound.
 ENSEMBLE_SPEEDUP_FLOOR = 2.0
 
+#: PROVISIONAL floor for the serving-layer batched A/B (bench_suite
+#: ``serve-batchN-speedup``: N tenants through ONE StencilServer —
+#: submit-all-then-wait-all, co-batched by the scheduler window — vs N
+#: fresh solo contexts each paying its own compile).  Same
+#: compile-amortization leg as the ensemble floor, MINUS the serving
+#: machinery's per-request tax (worker handoff, pre-request snapshots,
+#: journal rows, sanity gating), which is exactly what this row
+#: tracks: a regression here with a healthy ensemble row means the
+#: server got expensive, not the batching.  CPU-scoped; re-base on
+#: hardware.
+SERVE_BATCH_SPEEDUP_FLOOR = 1.5
+
 DEFAULT_RULES: List[GuardRule] = [
     GuardRule(name="iso3dfd-128-jit-floor",
               pattern="128^3 fp32 cpu throughput",
@@ -161,6 +173,10 @@ DEFAULT_RULES: List[GuardRule] = [
     GuardRule(name="ensemble-speedup-floor",
               pattern="ensemble",
               floor=ENSEMBLE_SPEEDUP_FLOOR, rel_tol=0.25,
+              platforms=("cpu",)),
+    GuardRule(name="serve-batch-speedup-floor",
+              pattern="serve-batch",
+              floor=SERVE_BATCH_SPEEDUP_FLOOR, rel_tol=0.25,
               platforms=("cpu",)),
     # the backstop every throughput/speedup row gets: trailing clean
     # median, generous tolerance (CPU-proxy trial noise is real)

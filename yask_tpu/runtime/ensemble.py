@@ -79,16 +79,30 @@ class EnsembleRun:
     to advance all members together.
     """
 
-    def __init__(self, ctx, n: int):
+    def __init__(self, ctx, n: Optional[int] = None,
+                 members: Optional[List] = None):
         ctx._check_prepared()
-        if n < 1:
+        if members is not None:
+            # Batch EXISTING RunStates (the serving scheduler's shape:
+            # each tenant session owns its state; a micro-batch groups
+            # them under the one prepared context without adopting the
+            # context's own current state as a member).
+            if n is not None and n != len(members):
+                raise YaskException(
+                    f"ensemble n={n} disagrees with {len(members)} "
+                    "explicit members")
+            n = len(members)
+        if n is None or n < 1:
             raise YaskException(f"ensemble size must be >= 1, got {n}")
         ok, why = ensemble_feasible(ctx)
         if not ok:
             raise YaskException(f"ensemble={n} infeasible: {why}")
         self._ctx = ctx
-        self._members: List = [ctx.get_run_state()]
-        self._members += [ctx.new_run_state() for _ in range(n - 1)]
+        if members is not None:
+            self._members = list(members)
+        else:
+            self._members = [ctx.get_run_state()]
+            self._members += [ctx.new_run_state() for _ in range(n - 1)]
         #: "" after a vmapped run; otherwise why the last run degraded
         #: to sequential members (still sharing compiled chunks).
         self.batched_reason = ""

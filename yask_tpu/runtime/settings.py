@@ -155,6 +155,13 @@ class KernelSettings:
         # (ensemble_feasible — the checker's ENSEMBLE-INFEASIBLE rule
         # reads the same definition).  1 = off.
         self.ensemble = 1
+        # Server-hosted solution (yask_tpu/serve/): set by
+        # StencilServer on the contexts it prepares (also -serve for
+        # explicit checker runs).  Gates the checker's serve pass
+        # (SERVE-BATCH-INCOMPAT / SERVE-CACHE-COLD) the same way the
+        # supervision knobs gate the ckpt pass — a non-serving
+        # `make check -all_stencils` stays silent.
+        self.serve = False
         # Supervised runs (yask_tpu/resilience/checkpoint.py): checkpoint
         # cadence in steps (0 = off — the hot path sees three int
         # compares and nothing else), snapshot directory (empty = the
@@ -271,6 +278,12 @@ class KernelSettings:
             "ensemble", "Batch N independent solution instances as one "
             "vmapped program (jit/pallas single-device modes; sharded "
             "modes decline).  1 = off.", self, "ensemble")
+        parser.add_bool_option(
+            "serve", "Mark this solution as server-hosted "
+            "(yask_tpu/serve/): enables the checker's serve pass "
+            "(batch-compatibility + compile-cache warmth).  "
+            "StencilServer sets it on the contexts it prepares.",
+            self, "serve")
         parser.add_int_option(
             "ckpt_every", "Checkpoint the run every N steps (portable "
             "interior-coordinate snapshots; 0 = off).", self,

@@ -1,0 +1,121 @@
+"""Request/response types + the ``YT_SERVE_*`` environment knobs.
+
+A :class:`ServeRequest` names a session and an inclusive step range —
+state lives server-side in the session's RunState, so a request is a
+"advance my simulation and hand back the written interiors" verb, the
+serving analog of ``run_solution(first_t, last_t)``.  The response
+carries the terminal journal state (``ok`` / ``anomaly`` /
+``rejected``), the latency split (queue / run; compile seconds are
+reported separately because a warm-started server's first request
+should show ~0), the batch occupancy the request actually rode, and
+the requested written-var interiors as numpy arrays (bit-identical to
+a solo ``run_solution`` — the acceptance contract).
+
+Env knobs (all optional; see ``docs/serving.md``):
+
+* ``YT_SERVE_WINDOW_MS``  — micro-batching window (default 5 ms on
+  CPU tests; the scheduler waits at most this long after the first
+  pending request for co-batchable company);
+* ``YT_SERVE_MAX_BATCH``  — occupancy cap per vmapped execution
+  (default 16);
+* ``YT_SERVE_DEADLINE``   — per-request deadline seconds passed to
+  ``guarded_call`` (default 300; SIGALRM only fires on the main
+  thread, so off-thread schedulers rely on fault classification —
+  documented limitation);
+* ``YT_SERVE_JOURNAL``    — journal path override (serve/journal.py).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+DEFAULT_WINDOW_MS = 5.0
+DEFAULT_MAX_BATCH = 16
+DEFAULT_DEADLINE_SECS = 300.0
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def serve_window_secs() -> float:
+    """The micro-batching window, seconds (``YT_SERVE_WINDOW_MS``)."""
+    return max(0.0, _env_float("YT_SERVE_WINDOW_MS",
+                               DEFAULT_WINDOW_MS)) / 1000.0
+
+
+def serve_max_batch() -> int:
+    try:
+        n = int(os.environ.get("YT_SERVE_MAX_BATCH", "")
+                or DEFAULT_MAX_BATCH)
+    except ValueError:
+        n = DEFAULT_MAX_BATCH
+    return max(1, n)
+
+
+def serve_deadline_secs() -> float:
+    return max(0.0, _env_float("YT_SERVE_DEADLINE",
+                               DEFAULT_DEADLINE_SECS))
+
+
+@dataclass
+class ServeRequest:
+    """One tenant's "advance my session" request.
+
+    ``outputs`` selects which written vars' newest-slot interiors ride
+    the response (empty = all written non-scratch vars);
+    ``deadline_secs`` 0 means the server default
+    (:func:`serve_deadline_secs`)."""
+    session: str
+    first_step: int
+    last_step: Optional[int] = None
+    outputs: Tuple[str, ...] = ()
+    deadline_secs: float = 0.0
+
+    def steps(self) -> Tuple[int, int]:
+        last = self.first_step if self.last_step is None \
+            else self.last_step
+        return int(self.first_step), int(last)
+
+
+@dataclass
+class ServeResponse:
+    """The released answer for one request (after sanity gating).
+
+    ``status`` is the journal's terminal state: ``ok`` (released),
+    ``anomaly`` (ran to completion but the sanity guards quarantined
+    the outputs — they still ride the response, flagged, so the tenant
+    sees WHAT happened), ``rejected`` (never produced releasable
+    output: unknown session, shutdown, or an unrecoverable fault after
+    the degradation ladder was exhausted — ``error`` says why)."""
+    rid: str = ""
+    session: str = ""
+    status: str = "rejected"
+    error: str = ""
+    #: occupancy of the vmapped execution this request rode (1 = ran
+    #: alone; >1 = micro-batched).
+    batch: int = 0
+    #: whether the batch actually executed vmapped (EnsembleRun can
+    #: degrade to sequential members and still answer).
+    batched: bool = False
+    #: mode that produced the answer + whether the session was walked
+    #: down the degradation ladder to get it.
+    mode: str = ""
+    degraded: bool = False
+    queue_secs: float = 0.0
+    run_secs: float = 0.0
+    compile_secs: float = 0.0
+    cache_hit: str = ""
+    #: var → newest-slot interior (numpy), per ``ServeRequest.outputs``.
+    outputs: Dict = field(default_factory=dict)
+    #: sanity verdict details when status == "anomaly".
+    anomaly: Dict = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"

@@ -1,0 +1,143 @@
+"""The serving journal: append-only ``SERVE_JOURNAL.jsonl``.
+
+Schema ``yask_tpu.serve/1`` — one row per request-lifecycle event::
+
+    {"v": "yask_tpu.serve/1",
+     "rid":     "r000007",             # request id
+     "session": "tenant-3",
+     "event":   "received|batched|ok|anomaly|rejected|fault|degraded",
+     "ts":      "2026-08-05T12:00:00Z",
+     "detail":  {...}}                 # event-specific (batch size,
+                                       # fault kind, ladder rung, ...)
+
+``ok`` / ``anomaly`` / ``rejected`` are terminal (``anomaly`` = the
+request ran to completion but its outputs were quarantined by the
+result-sanity guards — released to the tenant flagged, never banked
+clean); ``received`` / ``batched`` / ``fault`` / ``degraded`` are
+lifecycle evidence.  The ``batched`` rows carry the batch occupancy —
+the acceptance criterion "co-batchable requests actually batched"
+reads them.  Mechanics mirror
+:class:`yask_tpu.resilience.journal.SessionJournal` (append-only,
+malformed lines skipped on read, atomic compact between servers).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+SERVE_SCHEMA = "yask_tpu.serve/1"
+SERVE_JOURNAL_BASENAME = "SERVE_JOURNAL.jsonl"
+
+#: terminal request states — one of these must be the last event of
+#: every submitted request's lifecycle.
+SERVE_TERMINAL = ("ok", "anomaly", "rejected")
+
+SERVE_EVENTS = ("received", "batched", "ok", "anomaly", "rejected",
+                "fault", "degraded")
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def default_serve_journal_path() -> str:
+    return os.environ.get("YT_SERVE_JOURNAL") or os.path.join(
+        _repo_root(), SERVE_JOURNAL_BASENAME)
+
+
+def _utc_now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class ServeJournal:
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or default_serve_journal_path()
+
+    # ---------------------------------------------------------- write
+    def record(self, rid: str, session: str, event: str,
+               **detail) -> Dict:
+        """Append one lifecycle row.  Unlike the session journal this
+        never raises: serving must survive a read-only journal dir (a
+        tenant's answer cannot depend on evidence I/O), so failures
+        return the row un-persisted."""
+        if event not in SERVE_EVENTS:
+            raise ValueError(f"unknown serve journal event {event!r}; "
+                             f"one of {SERVE_EVENTS}")
+        row = {"v": SERVE_SCHEMA, "rid": str(rid),
+               "session": str(session), "event": str(event),
+               "ts": _utc_now()}
+        if detail:
+            row["detail"] = detail
+        try:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(row, sort_keys=True) + "\n")
+        except OSError:
+            pass
+        return row
+
+    # ----------------------------------------------------------- read
+    def rows(self) -> List[Dict]:
+        out: List[Dict] = []
+        try:
+            with open(self.path) as f:
+                for ln in f:
+                    ln = ln.strip()
+                    if not ln:
+                        continue
+                    try:
+                        row = json.loads(ln)
+                    except ValueError:
+                        continue
+                    if isinstance(row, dict) \
+                            and row.get("v") == SERVE_SCHEMA:
+                        out.append(row)
+        except OSError:
+            pass
+        return out
+
+    def events(self, rid: str) -> List[Dict]:
+        """One request's lifecycle, file order == time order."""
+        return [r for r in self.rows() if r.get("rid") == rid]
+
+    def terminal(self, rid: str) -> Optional[str]:
+        """The request's terminal state, or None while in flight."""
+        for r in reversed(self.events(rid)):
+            if r["event"] in SERVE_TERMINAL:
+                return r["event"]
+        return None
+
+    def max_occupancy(self) -> int:
+        """Largest batch size any ``batched`` row records (0 when the
+        server never batched) — the acceptance criterion's probe."""
+        best = 0
+        for r in self.rows():
+            if r["event"] == "batched":
+                best = max(best, int(r.get("detail", {})
+                                     .get("batch", 0)))
+        return best
+
+    # ----------------------------------------------------------- admin
+    def compact(self, keep_terminal_only: bool = True) -> int:
+        """Atomically rewrite to the last event per rid (terminal rows
+        preferred); run between servers, never during one."""
+        rows = self.rows()
+        last: Dict[str, Dict] = {}
+        order: List[str] = []
+        for r in rows:
+            rid = r.get("rid", "")
+            if rid not in last:
+                order.append(rid)
+            if not keep_terminal_only or r["event"] in SERVE_TERMINAL \
+                    or last.get(rid, {}).get("event") \
+                    not in SERVE_TERMINAL:
+                last[rid] = r
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            for rid in order:
+                f.write(json.dumps(last[rid], sort_keys=True) + "\n")
+        os.replace(tmp, self.path)
+        return len(rows) - len(order)

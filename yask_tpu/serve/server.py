@@ -1,0 +1,268 @@
+"""The in-process server: registry + scheduler + metrics under one
+facade.
+
+Lifecycle::
+
+    srv = StencilServer()                      # owns worker thread
+    sid = srv.open_session(stencil="iso3dfd", radius=2, g=16,
+                           mode="jit", wf=2)   # prepares ONCE per
+                                               # profile; later tenants
+                                               # share the executable
+    srv.set_var(sid, "vel", 0.5)               # state lives server-side
+    srv.set_var_slice(sid, "pressure", arr, first, last)
+    resp = srv.request(ServeRequest(session=sid, first_step=0,
+                                    last_step=3))
+    srv.metrics(); srv.flush_metrics()         # PERF_LEDGER rows
+    srv.shutdown()
+
+**Warm start**: every executable a request needs is built through
+``yask_tpu.cache.aot_compile``, so with ``YT_COMPILE_CACHE`` set a
+restarted server's first request deserializes from disk — zero
+lowerings (``cache.stats()["lowerings"] == 0``); :meth:`prewarm`
+optionally pulls the compile forward to ``open_session`` time.
+
+``open_session`` runs the checker's serve pass over the profile
+(LOG-ONLY, same policy as the bench preflight: a false positive must
+not refuse a tenant) — ``SERVE-BATCH-INCOMPAT`` and
+``SERVE-CACHE-COLD`` findings print to stderr and are kept on
+``last_preflight`` for inspection.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from yask_tpu.serve.api import ServeRequest, ServeResponse
+from yask_tpu.serve.journal import ServeJournal
+from yask_tpu.serve.registry import SessionRegistry
+from yask_tpu.serve.scheduler import BatchScheduler
+
+
+def _pctl(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[i]
+
+
+class StencilServer:
+    def __init__(self, env=None, factory=None,
+                 journal_path: Optional[str] = None,
+                 window_secs: Optional[float] = None,
+                 max_batch: Optional[int] = None,
+                 preflight: bool = True):
+        from yask_tpu import yk_factory
+        self._factory = factory or yk_factory()
+        self._env = env if env is not None else self._factory.new_env()
+        self.journal = ServeJournal(journal_path)
+        self.registry = SessionRegistry(self._factory, self._env)
+        self.scheduler = BatchScheduler(self.registry, self.journal,
+                                        window_secs=window_secs,
+                                        max_batch=max_batch)
+        self._preflight = bool(preflight)
+        #: last serve-pass CheckReport (LOG-ONLY evidence).
+        self.last_preflight = None
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------- sessions
+
+    def open_session(self, stencil: str, radius: Optional[int] = None,
+                     g=16, mode: str = "jit", wf: int = 2,
+                     options: str = "",
+                     session: Optional[str] = None) -> str:
+        prof = self.registry.get_profile(stencil, radius, g, mode,
+                                         wf, options)
+        if self._preflight:
+            self._run_preflight(prof)
+        return self.registry.open_session(prof, session).sid
+
+    def _run_preflight(self, prof) -> None:
+        """Serve-pass checks over the profile, log-only (the bench
+        preflight policy: findings print, the tenant is admitted)."""
+        try:
+            from yask_tpu.checker import run_checks
+            report = run_checks(prof.ctx, passes=("serve",))
+            self.last_preflight = report
+            if report.errors or report.warnings:
+                sys.stderr.write(report.render())
+        except Exception as e:  # noqa: BLE001 - a checker bug must
+            sys.stderr.write(   # never refuse a tenant
+                f"serve preflight: internal failure "
+                f"({type(e).__name__}: {e}); skipped\n")
+
+    def close_session(self, sid: str) -> None:
+        self.registry.close_session(sid)
+
+    def session_mode(self, sid: str) -> str:
+        return self.registry.session(sid).mode
+
+    # ----------------------------------------------- state in/out
+
+    def set_var(self, sid: str, var: str, value: float) -> None:
+        with self.scheduler.session_ctx(sid) as ctx:
+            ctx.get_var(var).set_all_elements_same(value)
+
+    def set_var_slice(self, sid: str, var: str, buf,
+                      first_indices, last_indices) -> int:
+        with self.scheduler.session_ctx(sid) as ctx:
+            return ctx.get_var(var).set_elements_in_slice(
+                np.asarray(buf), list(first_indices),
+                list(last_indices))
+
+    def get_var_slice(self, sid: str, var: str, first_indices,
+                      last_indices):
+        with self.scheduler.session_ctx(sid) as ctx:
+            return ctx.get_var(var).get_elements_in_slice(
+                list(first_indices), list(last_indices))
+
+    def init_vars(self, sid: str) -> None:
+        """The standard nonzero initial conditions
+        (``init_solution_vars``) for this session's state."""
+        from yask_tpu.runtime.init_utils import init_solution_vars
+        with self.scheduler.session_ctx(sid) as ctx:
+            init_solution_vars(ctx)
+
+    # ----------------------------------------------------- requests
+
+    def submit(self, req: ServeRequest):
+        return self.scheduler.submit(req)
+
+    def wait(self, handle, timeout: Optional[float] = None
+             ) -> ServeResponse:
+        return self.scheduler.wait(handle, timeout)
+
+    def request(self, req: ServeRequest,
+                timeout: Optional[float] = None) -> ServeResponse:
+        return self.scheduler.request(req, timeout)
+
+    def run(self, sid: str, first_step: int,
+            last_step: Optional[int] = None,
+            outputs=(), timeout: Optional[float] = None
+            ) -> ServeResponse:
+        return self.request(
+            ServeRequest(session=sid, first_step=first_step,
+                         last_step=last_step,
+                         outputs=tuple(outputs)), timeout)
+
+    def submit_run(self, sid: str, first_step: int,
+                   last_step: Optional[int] = None, outputs=()):
+        """Non-blocking :meth:`run` — returns the pending handle for
+        :meth:`wait`.  Submitting a whole sweep before waiting is what
+        lands compatible requests inside one batching window."""
+        return self.submit(
+            ServeRequest(session=sid, first_step=first_step,
+                         last_step=last_step,
+                         outputs=tuple(outputs)))
+
+    # ----------------------------------------------------- warm start
+
+    def prewarm(self, sid: str, steps: int) -> int:
+        """Build (or disk-load) the compiled chunks a ``steps``-long
+        request will need, ahead of the first request.  Returns the
+        number of chunk executables touched.  With ``YT_COMPILE_CACHE``
+        set and warm, this deserializes — zero lowerings."""
+        from yask_tpu.resilience.guard import guarded_call
+        sess = self.registry.session(sid)
+        n = max(1, int(steps))
+        with self.scheduler.session_ctx(sid) as ctx:
+            if sess.mode not in ("jit", "pallas"):
+                return 0
+            wf = ctx._opts.wf_steps
+            if sess.mode == "pallas":
+                wf = min(max(wf, 1), n)
+            elif wf <= 0:
+                wf = n
+            sizes = set()
+            rem = n
+            while rem > 0:
+                k = min(wf, rem)
+                sizes.add(k)
+                rem -= k
+            getter = ctx._get_pallas_chunk if sess.mode == "pallas" \
+                else ctx._get_compiled_chunk
+            for k in sorted(sizes):
+                guarded_call(getter, k, site="serve.run")
+            return len(sizes)
+
+    # ------------------------------------------------------- metrics
+
+    def metrics(self) -> Dict:
+        """Serving metrics over the retained samples: queue depth,
+        batch occupancy, p50/p99 latency split queue/run, cache-hit
+        tiers, degradation counts."""
+        samples = self.scheduler.samples()
+        done = [s for s in samples if s["status"] in ("ok", "anomaly")]
+        q = [s["queue_secs"] * 1e3 for s in done]
+        r = [s["run_secs"] * 1e3 for s in done]
+        tot = [(s["queue_secs"] + s["run_secs"]) * 1e3 for s in done]
+        occ = [s["batch"] for s in done]
+        hits: Dict[str, int] = {}
+        for s in done:
+            hits[s["cache_hit"]] = hits.get(s["cache_hit"], 0) + 1
+        return {
+            "queue_depth": self.scheduler.queue_depth(),
+            "sessions": len(self.registry.sessions()),
+            "profiles": len(self.registry.profiles()),
+            "completed": len(done),
+            "ok": sum(1 for s in done if s["status"] == "ok"),
+            "anomalies": sum(1 for s in done
+                             if s["status"] == "anomaly"),
+            "degraded": sum(1 for s in done if s["degraded"]),
+            "batch_occupancy_mean": (sum(occ) / len(occ)) if occ
+            else 0.0,
+            "batch_occupancy_max": max(occ) if occ else 0,
+            "p50_queue_ms": round(_pctl(q, 0.50), 3),
+            "p99_queue_ms": round(_pctl(q, 0.99), 3),
+            "p50_run_ms": round(_pctl(r, 0.50), 3),
+            "p99_run_ms": round(_pctl(r, 0.99), 3),
+            "p50_total_ms": round(_pctl(tot, 0.50), 3),
+            "p99_total_ms": round(_pctl(tot, 0.99), 3),
+            "compile_ms_total": round(sum(s["compile_secs"]
+                                          for s in done) * 1e3, 1),
+            "cache_hits": hits,
+        }
+
+    def flush_metrics(self) -> List[Dict]:
+        """Append the serving metrics to PERF_LEDGER.jsonl (source
+        ``serve``; latency/occupancy units are outside the sentinel's
+        guarded units by design — the guarded serving row is the
+        bench suite's ``serve-batch-speedup``)."""
+        from yask_tpu.perflab import capture_provenance
+        from yask_tpu.perflab.sentinel import guard_and_append
+        m = self.metrics()
+        if not m["completed"]:
+            return []
+        plat = self._env.get_platform()
+        prov = capture_provenance(platform=plat)
+        rows = []
+        for key, value, unit in (
+                ("serve p50 total latency", m["p50_total_ms"], "ms"),
+                ("serve p99 total latency", m["p99_total_ms"], "ms"),
+                ("serve batch occupancy mean",
+                 m["batch_occupancy_mean"], "reqs"),
+        ):
+            try:
+                rows.append(guard_and_append(
+                    key, float(value), unit, plat or "cpu", "serve",
+                    prov, extra={"completed": m["completed"],
+                                 "ok": m["ok"],
+                                 "anomalies": m["anomalies"],
+                                 "degraded": m["degraded"],
+                                 "p50_queue_ms": m["p50_queue_ms"],
+                                 "p50_run_ms": m["p50_run_ms"],
+                                 "occupancy_max":
+                                     m["batch_occupancy_max"],
+                                 "cache_hits": m["cache_hits"]}))
+            except Exception:  # noqa: BLE001 - ledger I/O must never
+                pass           # break serving
+        return rows
+
+    # ------------------------------------------------------ lifecycle
+
+    def shutdown(self) -> None:
+        self.scheduler.shutdown()
