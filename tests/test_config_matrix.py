@@ -179,3 +179,36 @@ def test_matrix_overlap_exchange(env, ovx, name, radius):
     # comparing serial to serial)
     _check(env, name, radius, "shard_pallas", wf=2, ranks=[("x", 2)],
            ovx=ovx)
+
+
+@pytest.mark.parametrize("mode,wf", [("jit", 1), ("jit", 2),
+                                     ("pallas", 1), ("pallas", 2)])
+@pytest.mark.parametrize("radius", [1, 2])
+def test_matrix_pipeline_fusion(env, mode, wf, radius):
+    # cross-solution pipeline fusion as a matrix axis: the 3-stage RTM
+    # chain fused into one program must agree with the host-chained
+    # oracle on every mode × wf × radius row (bit-equality per schedule
+    # lives in tests/test_pipeline.py; this sweep uses the standard
+    # cross-config tolerance like every other matrix row)
+    import numpy as np
+    from yask_tpu.ops.pipeline import SolutionPipeline, rtm_chain
+
+    def mk(fuse):
+        pipe = SolutionPipeline(env, *rtm_chain(radius=radius))
+        pipe.apply_command_line_options(
+            f"-g 16 -mode {mode} -wf_steps {wf}")
+        pipe.prepare(fuse=fuse)
+        v = pipe.get_var("fwd", "pressure")
+        rng = np.random.RandomState(3)
+        arr = (rng.rand(16, 16, 16).astype(np.float32) - 0.5) * 0.1
+        for t in range(v.get_first_valid_step_index(),
+                       v.get_last_valid_step_index() + 1):
+            v.set_elements_in_slice(arr, [t, 0, 0, 0],
+                                    [t, 15, 15, 15])
+        return pipe
+
+    fused, chained = mk(True), mk(False)
+    assert fused.fused and not chained.fused
+    fused.run(0, 1)
+    chained.run(0, 1)
+    assert fused.compare(chained, epsilon=1e-3, abs_epsilon=1e-4) == 0

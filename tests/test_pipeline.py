@@ -1,0 +1,285 @@
+"""Cross-solution pipeline fusion: fused arm vs host-chained oracle.
+
+The load-bearing property: the merged program (bound consumer inputs
+eliminated, reads rewritten to the producer's fresh +step value) is
+BIT-identical to the host-chained schedule — per step, per stage in
+order, each binding pushed through host interior copies — whenever the
+two arms run the same temporal schedule.  The pallas K>1 *chunked*
+schedule is only tolerance-equal to stepwise runs (a pre-existing
+FMA-reassociation property of temporal chunking, independent of
+fusion), so the K=2 chunked case gates at the repo's standard
+tolerance and the bit gates run schedule-matched.
+"""
+
+import numpy as np
+import pytest
+
+from yask_tpu import yk_factory
+
+
+@pytest.fixture(scope="module")
+def env():
+    return yk_factory().new_env()
+
+
+def _mk_pipe(env, cli, fuse=None, radius=2, g=16, seed=7):
+    from yask_tpu.ops.pipeline import SolutionPipeline, rtm_chain
+    stages, bindings = rtm_chain(radius=radius)
+    pipe = SolutionPipeline(env, stages, bindings)
+    pipe.apply_command_line_options(f"-g {g} " + cli)
+    pipe.prepare(fuse=fuse)
+    v = pipe.get_var("fwd", "pressure")
+    rng = np.random.RandomState(seed)
+    arr = (rng.rand(g, g, g).astype(np.float32) - 0.5) * 0.1
+    for t in range(v.get_first_valid_step_index(),
+                   v.get_last_valid_step_index() + 1):
+        v.set_elements_in_slice(arr, [t, 0, 0, 0],
+                                [t, g - 1, g - 1, g - 1])
+    return pipe
+
+
+# ---- bit-equality gates ---------------------------------------------------
+
+@pytest.mark.parametrize("wf", [1, 2])
+def test_jit_fused_bitequal_chained(env, wf):
+    fused = _mk_pipe(env, f"-mode jit -wf_steps {wf}", fuse=True)
+    chained = _mk_pipe(env, f"-mode jit -wf_steps {wf}", fuse=False)
+    assert fused.fused and not chained.fused
+    fused.run(0, 3)
+    chained.run(0, 3)
+    assert fused.compare(chained) == 0
+
+
+def test_pallas_k1_fused_bitequal_chained(env):
+    fused = _mk_pipe(env, "-mode pallas -wf_steps 1", fuse=True)
+    chained = _mk_pipe(env, "-mode pallas -wf_steps 1", fuse=False)
+    fused.run(0, 3)
+    chained.run(0, 3)
+    assert fused.compare(chained) == 0
+
+
+def test_pallas_wf2_stepwise_bitequal_chunked_tolerance(env):
+    # schedule-matched: fused wf=2 driven one step at a time is
+    # bit-identical to the (intrinsically stepwise) chained oracle;
+    # the K=2 *chunked* schedule is tolerance-equal only — the same
+    # 1-ulp property the standalone pallas K>1 path already has vs
+    # its own stepwise runs.
+    fused = _mk_pipe(env, "-mode pallas -wf_steps 2", fuse=True)
+    chained = _mk_pipe(env, "-mode pallas -wf_steps 1", fuse=False)
+    for t in range(4):
+        fused.run(t, t)
+    chained.run(0, 3)
+    assert fused.compare(chained) == 0
+
+    chunked = _mk_pipe(env, "-mode pallas -wf_steps 2", fuse=True)
+    chunked.run(0, 3)
+    assert chunked.compare(chained, epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+def test_fused_vs_chained_cross_mode_tolerance(env):
+    # fused pallas vs chained-jit: cross-mode, standard tolerance
+    fused = _mk_pipe(env, "-mode pallas -wf_steps 2", fuse=True)
+    chained = _mk_pipe(env, "-mode jit -wf_steps 1", fuse=False)
+    fused.run(0, 3)
+    chained.run(0, 3)
+    assert fused.compare(chained, epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+# ---- plan geometry --------------------------------------------------------
+
+def test_tileplan_stage_widths_sum_to_fused_radius(env):
+    from yask_tpu.ops.tile_planner import TilePlan
+    pipe = _mk_pipe(env, "-mode pallas -wf_steps 2", fuse=True)
+    prog = pipe.fused_ctx._program
+    tp = TilePlan(prog, 2)
+    sw = tp.stage_widths()
+    assert len(sw) == len(prog.stage_reads)
+    for d in tp.rad:
+        assert sum(w.get(d, 0) for w in sw) == tp.rad[d]
+
+
+def test_tileplan_stage_flow_nesting(env):
+    # inter-stage halo nesting: within one fused sub-step, stage si's
+    # read interval must equal stage si-1's write interval (the
+    # producer's fresh strip is exactly what the consumer consumes)
+    from yask_tpu.ops.tile_planner import TilePlan
+    pipe = _mk_pipe(env, "-mode pallas -wf_steps 2", fuse=True)
+    tp = TilePlan(pipe.fused_ctx._program, 2)
+    flow = tp.stage_flow({d: 8 for d in tp.rad})
+    assert flow
+    for entry in flow:
+        sts = entry["stages"]
+        for si in range(1, len(sts)):
+            assert sts[si]["read"] == sts[si - 1]["write"]
+        # every write nests inside the same stage's read
+        for st in sts:
+            for d, (lo, hi) in st["write"].items():
+                rlo, rhi = st["read"][d]
+                assert rlo <= lo and hi <= rhi
+
+
+def test_hbm_model_rtm_chain_halves_traffic(env):
+    from yask_tpu.ops.pipeline import pipeline_hbm_model
+    pipe = _mk_pipe(env, "-mode jit -wf_steps 1")
+    m = pipeline_hbm_model(pipe)
+    assert m["ratio"] == pytest.approx(2.0)
+    assert m["fused_bytes_pp"] < m["chained_bytes_pp"]
+
+
+# ---- ineligibility fallback matrix ---------------------------------------
+
+def _pipe_with(env, stages, bindings, cli="-g 16 -mode jit -wf_steps 1"):
+    from yask_tpu.ops.pipeline import SolutionPipeline
+    pipe = SolutionPipeline(env, stages, bindings)
+    pipe.apply_command_line_options(cli)
+    return pipe
+
+
+def _rtm(radius=2):
+    from yask_tpu.ops.pipeline import rtm_chain
+    return rtm_chain(radius=radius)
+
+
+@pytest.mark.parametrize("mutate,code", [
+    # producer var not written (vel is read-only)
+    (lambda s, b: (s, [("img", "fwd_in", "fwd", "vel")]),
+     "binding-producer"),
+    # consumer var unknown
+    (lambda s, b: (s, [("img", "nope", "fwd", "pressure")]),
+     "binding-unknown-var"),
+    # producer stage not earlier than consumer
+    (lambda s, b: (s, [("fwd", "vel", "img", "img")]),
+     "binding-order"),
+    # duplicate consumer binding
+    (lambda s, b: (s, [b[0], b[0]] + b[1:]), "binding-duplicate"),
+    # single stage
+    (lambda s, b: (s[:1], []), "stage-count"),
+    # reserved separator in a stage name
+    (lambda s, b: ([("a__b", s[0][1])] + s[1:], b), "stage-name"),
+], ids=["producer-unwritten", "unknown-var", "order", "duplicate",
+        "one-stage", "bad-name"])
+def test_ineligible_chain_declines_and_falls_back(env, mutate, code):
+    stages, bindings = _rtm()
+    s2, b2 = mutate(stages, bindings)
+    pipe = _pipe_with(env, s2, b2)
+    plan = pipe.prepare()
+    assert not pipe.fused
+    codes = {r["code"] for r in plan["reasons"] if not r.get("ok")}
+    assert code in codes, codes
+    # the host-chained fallback still executes
+    pipe.run(0, 0)
+    # and forcing fusion raises with the decline in the message
+    from yask_tpu.utils.exceptions import YaskException
+    pipe2 = _pipe_with(env, s2, b2)
+    with pytest.raises(YaskException):
+        pipe2.prepare(fuse=True)
+
+
+def test_forced_unfused_records_reason(env):
+    pipe = _mk_pipe(env, "-mode jit -wf_steps 1", fuse=False)
+    assert not pipe.fused
+    codes = {r["code"] for r in pipe.plan()["reasons"]}
+    assert "forced-unfused" in codes
+
+
+# ---- checker pass ---------------------------------------------------------
+
+def test_checker_pipeline_engaged(env):
+    from yask_tpu.checker import run_checks
+    pipe = _mk_pipe(env, "-mode pallas -wf_steps 2", fuse=True)
+    rep = run_checks(pipe.fused_ctx)
+    assert "pipeline" in rep.passes
+    eng = [d for d in rep.diagnostics if d.rule == "PIPELINE-ENGAGED"]
+    assert eng and eng[0].detail["fused"]
+    assert eng[0].detail["pallas"]["fuse_steps"] == 2
+
+
+def test_checker_pipeline_infeasible(env):
+    from yask_tpu.checker.pipeline_pass import check_pipeline_plan
+    stages, _ = _rtm()
+    pipe = _pipe_with(env, stages,
+                      [("img", "fwd_in", "fwd", "vel")])
+    rep = check_pipeline_plan(pipe)
+    rules = {d.rule for d in rep.diagnostics}
+    assert "PIPELINE-INFEASIBLE" in rules
+    assert rep.ok()   # warn-severity: the chain still runs host-chained
+
+
+def test_checker_pipeline_vmem_spill(env):
+    # the round-3 spill shape on the merged chain: explicit 64x64
+    # blocks at -vmem_mb 120 on a 512^3 domain — tiles pass the
+    # planning budget, the live-value model exceeds the Mosaic scoped
+    # limit.  Static decline, nothing allocated.
+    from yask_tpu.checker.pipeline_pass import check_pipeline_plan
+    from yask_tpu.ops.pipeline import SolutionPipeline, rtm_chain
+    stages, bindings = rtm_chain(radius=2)
+    pipe = SolutionPipeline(env, stages, bindings)
+    pipe.apply_command_line_options(
+        "-g 512 -mode pallas -wf_steps 2 -b 64 -vmem_mb 120")
+    rep = check_pipeline_plan(pipe)
+    spills = [d for d in rep.errors if d.rule == "PIPELINE-VMEM-SPILL"]
+    assert spills, rep.render(verbose=True)
+
+
+def test_checker_skips_non_pipeline_ctx(env):
+    from yask_tpu.checker import run_checks
+    fac = yk_factory()
+    ctx = fac.new_solution(env, stencil="iso3dfd", radius=2)
+    ctx.apply_command_line_options("-g 16")
+    rep = run_checks(ctx, passes=["pipeline"])
+    assert {d.rule for d in rep.diagnostics} == {"PIPELINE-SKIPPED"}
+
+
+# ---- AOT cache key --------------------------------------------------------
+
+def test_pipeline_signature_in_variant_key(env):
+    pipe = _mk_pipe(env, "-mode pallas -wf_steps 1", fuse=True)
+    ctx = pipe.fused_ctx
+    key = ctx._pallas_variant_key()
+    assert key[-1] == pipe.signature()
+    saved = ctx._pipeline_sig
+    try:
+        ctx._pipeline_sig = None
+        assert ctx._pallas_variant_key() != key
+    finally:
+        ctx._pipeline_sig = saved
+
+
+def test_signature_distinguishes_chains(env):
+    from yask_tpu.ops.pipeline import SolutionPipeline, rtm_chain
+    stages, bindings = rtm_chain(radius=2)
+    a = SolutionPipeline(env, stages, bindings)
+    b = SolutionPipeline(env, rtm_chain(radius=2)[0], bindings[:1])
+    assert a.signature() != b.signature()
+
+
+# ---- tuner A/B ------------------------------------------------------------
+
+def test_tuner_pipeline_ab_records_verdict(env):
+    from yask_tpu.runtime.auto_tuner import AutoTuner
+    pipe = _mk_pipe(env, "-mode pallas -wf_steps 1", fuse=True)
+    ctx = pipe.fused_ctx
+    tuner = AutoTuner(ctx)
+    tuner.trial_secs = 0.05
+    tuner.best_rate = None
+    tuner._pipeline_ab(1)
+    verdicts = [r for r in pipe.plan()["reasons"]
+                if r["code"] == "pipeline-ab"]
+    assert verdicts
+    v = verdicts[0]
+    assert v["fused_secs_per_step"] > 0
+    assert v["chained_secs_per_step"] > 0
+    # the pinned arm agrees with the measured winner
+    assert pipe.fused == (v["fused_secs_per_step"]
+                          <= v["chained_secs_per_step"])
+
+
+def test_tuner_ab_skips_non_pipeline_ctx(env):
+    from yask_tpu.runtime.auto_tuner import AutoTuner
+    fac = yk_factory()
+    ctx = fac.new_solution(env, stencil="iso3dfd", radius=2)
+    ctx.apply_command_line_options("-g 16 -mode pallas -wf_steps 1")
+    ctx.prepare_solution()
+    tuner = AutoTuner(ctx)
+    tuner._pipeline_ab(1)   # no pipeline: must be a silent no-op
+    assert not tuner.results

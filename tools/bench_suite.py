@@ -687,6 +687,88 @@ def run_suite(fac, env, budget_secs=None):
              tenants=N, occupancy=occ, seq_secs=round(t_seq, 3),
              serve_secs=round(t_srv, 3))
 
+    def pipeline_fusion_ab():
+        # Cross-solution pipeline-fusion A/B on the 3-stage RTM chain
+        # (forward iso wave -> imaging correlation -> 3-point
+        # smoothing): the fused arm is ONE merged program (bound vars
+        # never round-trip HBM; the model says 2× traffic for this
+        # chain), the chained arm is the host-chained oracle — per
+        # step, per stage, each binding pushed through host slice
+        # copies.  Correctness gate: every written var of every stage
+        # BIT-identical between arms — both arms run the same jit
+        # temporal schedule, where the merge is exact (the pallas K>1
+        # chunked schedule is only tolerance-equal to stepwise runs,
+        # a pre-existing property of temporal chunking, so the perf
+        # headline for that path lives in tpu_session, not here).
+        # Timing excludes the warmup/compile window on both sides:
+        # unlike the ensemble row, the fusion win being tracked is
+        # steady-state traffic + dispatch + push tax, not compile
+        # amortization.  PIPELINE_FUSION_FLOOR (1.2×) is CPU-scoped.
+        import numpy as np
+        from yask_tpu.ops.pipeline import (SolutionPipeline, rtm_chain,
+                                           pipeline_hbm_model)
+        g = 64 if on_tpu else 32
+
+        def mk(fuse):
+            stages, bindings = rtm_chain(radius=2)
+            pipe = SolutionPipeline(env, stages, bindings)
+            pipe.apply_command_line_options(f"-g {g} -mode jit "
+                                            "-wf_steps 2")
+            pipe.prepare(fuse=fuse)
+            v = pipe.get_var("fwd", "pressure")
+            rng = np.random.RandomState(7)
+            arr = (rng.rand(g, g, g).astype(np.float32) - 0.5) * 0.1
+            for t in range(v.get_first_valid_step_index(),
+                           v.get_last_valid_step_index() + 1):
+                v.set_elements_in_slice(arr, [t, 0, 0, 0],
+                                        [t, g - 1, g - 1, g - 1])
+            return pipe
+
+        fused, chained = mk(True), mk(False)
+        # warmup window pays trace+lower+compile on both sides AND
+        # feeds the bit-equality gate
+        fused.run(0, steps - 1)
+        chained.run(0, steps - 1)
+        bad = fused.compare(chained)
+        if bad:
+            raise RuntimeError(
+                f"pipeline fusion not bit-identical to the "
+                f"host-chained oracle ({bad} mismatching elements)")
+
+        def arms(lo, hi):
+            t0f = time.perf_counter()
+            fused.run(lo, hi)
+            tf = time.perf_counter() - t0f
+            t0c = time.perf_counter()
+            chained.run(lo, hi)
+            return tf, time.perf_counter() - t0c
+
+        t_fused = t_chain = 0.0
+        trials = 3
+        for i in range(trials):
+            tf, tc = arms((i + 1) * steps, (i + 2) * steps - 1)
+            t_fused += tf
+            t_chain += tc
+        bad = fused.compare(chained)
+        if bad:
+            raise RuntimeError(
+                f"pipeline fusion diverged from the host-chained "
+                f"oracle during timed steps ({bad} mismatches)")
+
+        def remeasure_ratio():
+            tf, tc = arms((trials + 1) * steps,
+                          (trials + 2) * steps - 1)
+            return tc / max(tf, 1e-12)
+
+        hbm = pipeline_hbm_model(fused)
+        emit(f"rtm3 r=2 {g}^3 {plat} pipeline-fusion-speedup",
+             t_chain / max(t_fused, 1e-12), "x",
+             remeasure=remeasure_ratio, stages=len(fused.stage_names),
+             fused=fused.fused, chained_secs=round(t_chain, 3),
+             fused_secs=round(t_fused, 3), hbm_bytes_model=hbm)
+        fused.end()
+        chained.end()
+
     # explicit section(...) calls (not a loop over a tuple): repo_lint's
     # BARE-DEVICE-CALL closure sanctions device work lexically, from
     # the names passed into the guard invokers
@@ -702,6 +784,7 @@ def run_suite(fac, env, budget_secs=None):
     section(sp_overlap, t0, budget_secs)
     section(ensemble_ab, t0, budget_secs)
     section(serve_batch_ab, t0, budget_secs)
+    section(pipeline_fusion_ab, t0, budget_secs)
     return list(ROWS)
 
 

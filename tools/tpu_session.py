@@ -69,7 +69,8 @@ MATRIX = [
 ]
 
 STAGES = ("smoke", "validate", "chunk_abs", "tune_bench",
-          "compile_cache_ab", "ensemble_ab", "compile_time")
+          "compile_cache_ab", "ensemble_ab", "pipeline_fusion_ab",
+          "serving", "compile_time")
 
 
 def matrix_cases():
@@ -1032,6 +1033,187 @@ def main(argv=None) -> int:
                     "anomalies": [f"ensemble-mismatch:{mismatches}"]}
         return {}
 
+    def pipeline_fusion_case():
+        """Cross-solution pipeline fusion on the real backend: the
+        3-stage RTM chain as ONE merged pallas program vs the
+        host-chained oracle.  The bit-equality gate runs BOTH arms on
+        matched temporal schedules (stepwise — the repo's K>1 chunked
+        schedule is only tolerance-equal to stepwise runs, a
+        pre-existing FMA-reassociation property of temporal chunking,
+        not a fusion defect); the perf ratio then times the fused arm
+        at K=2 chunks against the per-step chained schedule — the
+        composed cross-solution + temporal fusion win this PR ships.
+        A corrupt arm (sanity guards) is withheld from the comparison
+        and banks quarantined."""
+        from yask_tpu.ops.pipeline import (SolutionPipeline, rtm_chain,
+                                           pipeline_hbm_model)
+        gp = 128 if plat == "tpu" else 32
+        steps_p = 4
+
+        def mk(fuse, wf):
+            stages_, bindings = rtm_chain(radius=2)
+            pipe = SolutionPipeline(env, stages_, bindings)
+            pipe.apply_command_line_options(
+                f"-g {gp} -mode pallas -wf_steps {wf}")
+            pipe.prepare(fuse=fuse)
+            v = pipe.get_var("fwd", "pressure")
+            rng = np.random.RandomState(11)
+            arr = (rng.rand(gp, gp, gp).astype(np.float32) - 0.5) * 0.1
+            for t in range(v.get_first_valid_step_index(),
+                           v.get_last_valid_step_index() + 1):
+                v.set_elements_in_slice(arr, [t, 0, 0, 0],
+                                        [t, gp - 1, gp - 1, gp - 1])
+            return pipe
+
+        # bit-equality gate on matched schedules: fused stepwise vs
+        # the (intrinsically stepwise) chained oracle
+        fused1, chained = mk(True, 1), mk(False, 1)
+        for t in range(steps_p):
+            fused1.run(t, t)
+        chained.run(0, steps_p - 1)
+        vlast = fused1.get_var("smooth", "smooth")
+        sanity = check_output(
+            maybe_corrupt("session.pipeline_result",
+                          fused1._interior(
+                              "smooth", "smooth",
+                              vlast.get_last_valid_step_index())))
+        mismatches = 0
+        if sanity["ok"]:   # corrupt arm: comparison withheld
+            mismatches = int(fused1.compare(chained))
+        fused1.end()
+
+        # perf arms: fused K=2 chunks vs the per-step chained schedule
+        fused2 = mk(True, 2)
+        fused2.run(0, steps_p - 1)      # warm (compile)
+        t0f = time.perf_counter()
+        fused2.run(steps_p, 2 * steps_p - 1)
+        t_fused = time.perf_counter() - t0f
+        t0c = time.perf_counter()
+        chained.run(steps_p, 2 * steps_p - 1)
+        t_chain = time.perf_counter() - t0c
+
+        line = {"metric": f"rtm3 r=2 {gp}^3 {plat} "
+                          "pipeline-fusion-speedup",
+                "value": round(t_chain / max(t_fused, 1e-12), 4),
+                "unit": "x", "platform": plat,
+                "stages": len(fused2.stage_names),
+                "fused": fused2.fused, "wf": 2,
+                "chained_secs": round(t_chain, 3),
+                "fused_secs": round(t_fused, 3),
+                "hbm_bytes_model": pipeline_hbm_model(fused2),
+                "mismatches": mismatches}
+        log("pipeline_fusion_ab", **line,
+            **({"anomalies": sanity["anomalies"]}
+               if not sanity["ok"] else {}))
+        if should_bank:
+            record(line, sanity=sanity)
+        fused2.end()
+        chained.end()
+        if not sanity["ok"]:
+            return {"outcome": "anomaly",
+                    "anomalies": sanity["anomalies"]}
+        if mismatches:
+            return {"outcome": "anomaly",
+                    "anomalies": [f"pipeline-mismatch:{mismatches}"]}
+        return {}
+
+    def serving_case():
+        """Serving-layer batched A/B on the real backend (the serving
+        stage the round-10 ROADMAP left unwritten): N tenants through
+        ONE StencilServer — submit-all-then-wait-all so the batching
+        window co-batches them — vs N fresh solo contexts each paying
+        its own compile.  Response bit-identity to the sequential
+        twins is the gate; a corrupt serve arm is withheld from the
+        comparison and banks quarantined."""
+        from yask_tpu import cache as ccache
+        from yask_tpu.serve import StencilServer
+        from yask_tpu.serve.scheduler import extract_outputs
+        N = 4
+        gs = 128 if plat == "tpu" else 32
+        steps_s = 4
+
+        def seed_arr(i):
+            rng = np.random.RandomState(700 + i)
+            return (rng.rand(1, gs, gs, gs).astype(np.float32)
+                    - 0.5) * 0.1
+
+        saved = os.environ.pop("YT_COMPILE_CACHE", None)
+        try:
+            ctxs = []
+            for i in range(N):
+                c = build(fac, env, "iso3dfd", "jit", gs, 8, wf=2)
+                c.get_var("pressure").set_elements_in_slice(
+                    seed_arr(i), [0, 0, 0, 0],
+                    [0, gs - 1, gs - 1, gs - 1])
+                ctxs.append(c)
+            t0s = time.perf_counter()
+            for c in ctxs:
+                ccache.clear_memo()   # N tenants, N compiles
+                c.run_solution(0, steps_s - 1)
+            t_seq = time.perf_counter() - t0s
+            seq_outs = [extract_outputs(c) for c in ctxs]
+            del ctxs
+
+            srv = StencilServer(window_secs=0.1, max_batch=N,
+                                preflight=False)
+            sids = []
+            for i in range(N):
+                sid = srv.open_session(stencil="iso3dfd", radius=8,
+                                       g=gs, mode="jit", wf=2)
+                srv.init_vars(sid)
+                with srv.scheduler.session_ctx(sid) as c:
+                    c.get_var("pressure").set_elements_in_slice(
+                        seed_arr(i), [0, 0, 0, 0],
+                        [0, gs - 1, gs - 1, gs - 1])
+                sids.append(sid)
+            ccache.clear_memo()
+            t0b = time.perf_counter()
+            handles = [srv.submit_run(sid, 0, steps_s - 1)
+                       for sid in sids]
+            resps = [srv.wait(h, timeout=600) for h in handles]
+            t_srv = time.perf_counter() - t0b
+            occ = max((r.batch for r in resps), default=0)
+            srv.shutdown()
+        finally:
+            if saved is not None:
+                os.environ["YT_COMPILE_CACHE"] = saved
+        bad_resps = [r.rid for r in resps if not r.ok]
+        first = next((r for r in resps if r.ok), None)
+        probe = (next(iter(first.outputs.values()))
+                 if first and first.outputs else np.zeros(1))
+        sanity = check_output(
+            maybe_corrupt("session.serve_result", np.asarray(probe)))
+        mismatches = 0
+        if sanity["ok"]:   # corrupt serve arm: comparison withheld
+            for i, (want, r) in enumerate(zip(seq_outs, resps)):
+                if not r.ok:
+                    continue
+                for n, a in want.items():
+                    if not np.array_equal(a, r.outputs[n]):
+                        mismatches += 1
+        line = {"metric": f"iso3dfd r=8 {gs}^3 {plat} "
+                          f"serve-batch{N}-speedup",
+                "value": round(t_seq / max(t_srv, 1e-12), 4),
+                "unit": "x", "platform": plat, "tenants": N,
+                "occupancy": occ, "seq_secs": round(t_seq, 3),
+                "serve_secs": round(t_srv, 3),
+                "failed": len(bad_resps), "mismatches": mismatches}
+        log("serving", **line,
+            **({"anomalies": sanity["anomalies"]}
+               if not sanity["ok"] else {}))
+        if should_bank:
+            record(line, sanity=sanity)
+        if not sanity["ok"]:
+            return {"outcome": "anomaly",
+                    "anomalies": sanity["anomalies"]}
+        if bad_resps or mismatches:
+            return {"outcome": "anomaly",
+                    "anomalies": ([f"serve-failed:{len(bad_resps)}"]
+                                  if bad_resps else [])
+                    + ([f"serve-mismatch:{mismatches}"]
+                       if mismatches else [])}
+        return {}
+
     rc = 0
     try:
         if "smoke" in stages:
@@ -1067,6 +1249,13 @@ def main(argv=None) -> int:
             runner.run_case("compile_cache_ab", "", compile_cache_case)
         if "ensemble_ab" in stages:
             runner.run_case("ensemble_ab", "", ensemble_case)
+        # 6b) pipeline fusion + serving A/Bs: same cheap-and-banked
+        #     policy as the cache/ensemble rows
+        if "pipeline_fusion_ab" in stages:
+            runner.run_case("pipeline_fusion_ab", "",
+                            pipeline_fusion_case)
+        if "serving" in stages:
+            runner.run_case("serving", "", serving_case)
 
         # 5b) quick sessions validate AFTER the perf stages are banked
         if quick and "validate" in stages:

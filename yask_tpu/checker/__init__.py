@@ -22,6 +22,10 @@ geometry) and emits structured diagnostics.  Five passes:
 * ``serve``       — server-hosted profile checks (micro-batching
                     compatibility of the configured mode, compile-cache
                     warmth for warm restart); gated on ``-serve``;
+* ``pipeline``    — cross-solution pipeline fusion feasibility (fuse vs
+                    host-chain, fused VMEM spill) from the same plan
+                    dict the executor decides from; skipped for
+                    contexts outside a pipeline;
 * ``explain``     — every pallas/skew/pipelining decision and fallback
                     as a structured reason.
 
@@ -45,7 +49,7 @@ __all__ = ["CheckReport", "Diagnostic", "SCHEMA", "run_checks",
            "preflight"]
 
 PASSES = ("mosaic", "vmem", "races", "distributed", "cache", "ckpt",
-          "serve", "explain")
+          "serve", "pipeline", "explain")
 
 
 def _dtype_name(dt) -> str:
@@ -126,6 +130,12 @@ def run_checks(ctx, passes=None) -> CheckReport:
     if "serve" in want:
         from yask_tpu.checker.serve_pass import check_serve
         check_serve(report, ctx)
+    # pipeline pass: fuse/decline reproduction off the executor's own
+    # plan dict (pipeline_plan does its own geometry planning; plan-free
+    # here, and a no-pipeline context just gets a skip note)
+    if "pipeline" in want:
+        from yask_tpu.checker.pipeline_pass import check_pipeline
+        check_pipeline(report, ctx)
 
     if program is not None:
         if "mosaic" in want:

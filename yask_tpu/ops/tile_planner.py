@@ -229,6 +229,60 @@ class TilePlan:
             steps.append(entry)
         return steps
 
+    # -- multi-stage dataflow (cross-solution pipeline fusion) ---------
+
+    def stage_widths(self) -> List[Dict[str, int]]:
+        """Per ANALYSIS stage, per lead dim: the max one-side ghost
+        width that stage's reads consume — the per-stage slices of the
+        fused radius, straight off ``program.stage_reads`` (the same
+        ``stage_read_widths`` definition every other margin consumer
+        uses).  Invariant: the per-dim sum over stages equals
+        ``self.rad`` (``fused_step_radius``) — a merged
+        producer→consumer chain's inter-stage halo margins are exactly
+        these widths, one slice per stage."""
+        out = []
+        for reads in self.program.stage_reads:
+            w = {d: 0 for d in self.lead}
+            for vv in reads.values():
+                for d, (l, r) in vv.items():
+                    if d in w:
+                        w[d] = max(w[d], l, r)
+            out.append(w)
+        return out
+
+    def stage_flow(self, block: Dict[str, int]) -> List[Dict]:
+        """Per sub-step level, per analysis stage: the stage's write
+        and read intervals of one tile (tile-origin-relative, lead
+        dims).  The FINAL stage writes the level's output window
+        (:meth:`dataflow`'s ``write``); each upstream stage's window is
+        expanded per side by the downstream tail (the sum of later
+        stages' :meth:`stage_widths`) — consumer stages evaluate
+        in-tile over write-halo-expanded producer windows, the
+        scratch-var chain rule generalized to whole fused solutions.
+        Nesting invariant: stage ``si``'s read interval equals stage
+        ``si−1``'s write interval (each stage produces exactly what
+        the next consumes)."""
+        sw = self.stage_widths()
+        tails: List[Dict[str, int]] = []
+        acc = {d: 0 for d in self.lead}
+        for w in reversed(sw):
+            tails.append(dict(acc))
+            acc = {d: acc[d] + w[d] for d in self.lead}
+        tails.reverse()
+        flow = []
+        for entry in self.dataflow(block):
+            stages = []
+            for si, w in enumerate(sw):
+                wr, rd = {}, {}
+                for d in self.lead:
+                    lo, hi = entry["write"][d]
+                    t = tails[si][d]
+                    wr[d] = (lo - t, hi + t)
+                    rd[d] = (lo - t - w[d], hi + t + w[d])
+                stages.append({"stage": si, "write": wr, "read": rd})
+            flow.append({"level": entry["level"], "stages": stages})
+        return flow
+
     # -- cost model ----------------------------------------------------
 
     def volumes(self, block: Dict[str, int]) -> Tuple[int, int, int]:

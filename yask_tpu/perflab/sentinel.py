@@ -151,6 +151,21 @@ ENSEMBLE_SPEEDUP_FLOOR = 2.0
 #: hardware.
 SERVE_BATCH_SPEEDUP_FLOOR = 1.5
 
+#: PROVISIONAL floor for the cross-solution pipeline-fusion A/B
+#: (bench_suite ``pipeline-fusion-speedup``: the 3-stage RTM chain —
+#: forward iso wave, imaging correlation, 3-point smoothing — as ONE
+#: merged program vs the host-chained schedule that round-trips every
+#: binding through HBM plus host slice copies each step).  The HBM
+#: model says 2× traffic for this chain (bound vars stream once
+#: instead of write+read), and the chained arm additionally pays the
+#: host push per binding per step, so ≥1.2× is conservative on the
+#: CPU proxy where the push tax dominates.  The failure class this
+#: guards: the merge silently falling back to host-chaining (fused
+#: False in the ledger row) or a rewrite pessimization making the
+#: merged program slower than its parts.  CPU-scoped: re-base on
+#: hardware once tpu_session banks the pipeline_fusion_ab stage.
+PIPELINE_FUSION_FLOOR = 1.2
+
 DEFAULT_RULES: List[GuardRule] = [
     GuardRule(name="iso3dfd-128-jit-floor",
               pattern="128^3 fp32 cpu throughput",
@@ -177,6 +192,10 @@ DEFAULT_RULES: List[GuardRule] = [
     GuardRule(name="serve-batch-speedup-floor",
               pattern="serve-batch",
               floor=SERVE_BATCH_SPEEDUP_FLOOR, rel_tol=0.25,
+              platforms=("cpu",)),
+    GuardRule(name="pipeline-fusion-floor",
+              pattern="pipeline-fusion",
+              floor=PIPELINE_FUSION_FLOOR, rel_tol=0.25,
               platforms=("cpu",)),
     # the backstop every throughput/speedup row gets: trailing clean
     # median, generous tolerance (CPU-proxy trial noise is real)

@@ -496,6 +496,7 @@ class AutoTuner:
 
         best_k = self._walk_ladder(walk_one, lead)
         self._trapezoid_ab(best_k)
+        self._pipeline_ab(best_k)
         return best_k
 
     def _trapezoid_ab(self, kw: int) -> None:
@@ -544,6 +545,78 @@ class AutoTuner:
         ctx._env.trace_msg(
             f"auto-tuner: trapezoid={'on' if win else 'off'} "
             f"(on {r_on * 1e3:.3f} vs off {r_off * 1e3:.3f} ms/step)")
+
+    def _pipeline_ab(self, kw: int) -> None:
+        """Fused vs host-chained pipeline arm, A/B'd at the winning
+        (K, blocks, vmem) point of the joint walk — only when this
+        context is the fused program of a
+        :class:`~yask_tpu.ops.pipeline.SolutionPipeline` that engaged.
+        The chained arm replays the per-step per-stage schedule
+        (binding pushes included — its real cost) on trial copies of
+        the stage states; the losing arm is pinned into the pipeline
+        and the verdict recorded as a structured reason, so a fusion
+        the HBM model likes but the measurement overrules never runs
+        in production."""
+        import jax.numpy as jnp
+        ctx = self.ctx
+        pipe = getattr(ctx, "_pipeline", None)
+        if pipe is None or not getattr(pipe, "_fused", False):
+            return
+        kw = max(kw, 1)
+
+        def mk():
+            return ctx._get_pallas_chunk(kw)
+
+        r_fused = self._measure(("pipe", "fused", kw), mk, k=kw)
+
+        from yask_tpu.utils.exceptions import YaskException
+        try:
+            ctxs = pipe._ensure_stage_ctxs()
+        except YaskException as e:
+            ctx._env.trace_msg(
+                f"auto-tuner: pipeline chained arm unpreparable ({e}); "
+                "keeping fused")
+            return
+        saved = {}
+        for s, c in ctxs.items():
+            c._materialize_state()
+            c._state_to_device()
+            saved[s] = (c._state, c._cur_step, c._steps_done)
+            c._state = {k: [jnp.copy(a) for a in ring]
+                        for k, ring in c._state.items()}
+        c0 = ctxs[pipe.stage_names[0]]
+        dirn = c0._ana.step_dir
+        t0 = c0._cur_step
+
+        def call(_):
+            pipe._run_chained(t0, t0 + (kw - 1) * dirn)
+
+        try:
+            r_chain = self._measure(("pipe", "chained", kw),
+                                    lambda: None, call=call, k=kw)
+        finally:
+            for s, c in ctxs.items():
+                c._state, c._cur_step, c._steps_done = saved[s]
+        if r_fused == float("inf") and r_chain == float("inf"):
+            return
+        win_fused = r_fused <= r_chain
+        verdict = {
+            "code": "pipeline-ab", "ok": True,
+            "msg": (f"tuner A/B at K={kw}: fused "
+                    f"{r_fused * 1e3:.3f} vs chained "
+                    f"{r_chain * 1e3:.3f} ms/step -> "
+                    f"{'fused' if win_fused else 'host-chained'}"),
+            "fused_secs_per_step": r_fused,
+            "chained_secs_per_step": r_chain,
+        }
+        plan = getattr(pipe, "_plan", None)
+        if plan is not None:
+            plan["reasons"].append(verdict)
+        if not win_fused:
+            pipe._fused = False
+            if plan is not None:
+                plan["fused"] = False
+        ctx._env.trace_msg("auto-tuner: " + verdict["msg"])
 
     def _walk_joint_shard(self, candidates=None) -> int:
         """Joint (K, block-shape) walk for the distributed shard_pallas

@@ -83,6 +83,14 @@ class StencilContext:
 
         self._compile_secs = 0.0
         self._last_cache_hit = None     # cache verdict of latest build
+        # cross-solution pipeline fusion (yask_tpu.ops.pipeline): the
+        # merged-chain signature is one more variant-key dimension —
+        # a fused chain must never alias an unfused solution's cached
+        # executable — and the owning SolutionPipeline registers
+        # itself for the tuner's fused-vs-chained arm.
+        self._pipeline_sig = None
+        self._pipeline = None
+        self._pipeline_plan = None
 
         self._hooks: Dict[str, List[Callable]] = {
             "before_prepare": [], "after_prepare": [],
@@ -1000,7 +1008,10 @@ class StencilContext:
         # toggling them must never alias another schedule's executable
         cmo = getattr(o, "comm_order", "")
         col = getattr(o, "coalesce", "auto")
-        return (skw, sdm, o.vmem_budget_mb, ovx, trz, cmo, col)
+        # pipeline-fusion signature: a merged producer→consumer chain
+        # compiles a different kernel than any standalone solution
+        psig = self._pipeline_sig or ""
+        return (skw, sdm, o.vmem_budget_mb, ovx, trz, cmo, col, psig)
 
     def comm_plan(self, K: Optional[int] = None):
         """The communication schedule (CommPlan) for the configured
