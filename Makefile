@@ -63,10 +63,13 @@ cachecheck: lint
 # acceptance path (two prepared stencils, 8 concurrent tenants,
 # bit-identity + occupancy > 1 + warm-restart zero lowerings), the
 # injected serve.run degradation ladder, sanity quarantine on release,
-# journal schema, and the SERVE-* checker rules (see docs/serving.md)
+# journal schema, the SERVE-* checker rules, shape-bucket co-batching
+# bit-identity, streaming/preemption, and the warm-cache worker fleet
+# (see docs/serving.md)
 servecheck: lint
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m pytest \
-		tests/test_serve.py -q
+		tests/test_serve.py tests/test_serve_buckets.py \
+		tests/test_fleet.py -q
 
 # static checker over the flagship configs: Mosaic legality, VMEM
 # feasibility (incl. the round-3 spill-OOM class), races, explain.

@@ -132,6 +132,59 @@ def test_settings_knob_feeds_new_ensemble(env):
     assert ens.n == 3
 
 
+def test_masked_sub_domain_bit_identical_to_solo(env):
+    """A member hosted as a masked sub-domain of a larger geometry
+    produces, over its own domain, the same bits as a solo run at that
+    geometry — the serve-side shape-bucketing contract.  Full-domain
+    members co-batching with it stay exact too."""
+    sub = 12
+    solo_sub = yk_factory().new_solution(env, stencil="iso3dfd",
+                                         radius=2)
+    solo_sub.apply_command_line_options(f"-g {sub} -wf_steps 2")
+    solo_sub.get_settings().mode = "jit"
+    solo_sub.prepare_solution()
+    solo_sub.get_var("vel").set_all_elements_same(0.5)
+    rng = np.random.RandomState(100)
+    arr = (rng.rand(sub, sub, sub).astype(np.float32) - 0.5) * 0.1
+    solo_sub.get_var("pressure").set_elements_in_slice(
+        arr, [0, 0, 0, 0], [0, sub - 1, sub - 1, sub - 1])
+    solo_sub.run_solution(0, STEPS - 1)
+
+    solo_full = make_ctx(env, "jit", i=1)
+    solo_full.run_solution(0, STEPS - 1)
+    full_snap = state_snapshot(solo_full)
+
+    ctx = make_ctx(env, "jit")
+    # member 0: the 12^3 tenant (vel fill strays over the whole bucket
+    # on purpose — the initial-state mask must zero the stray region)
+    ctx.get_var("pressure").set_elements_in_slice(
+        arr, [0, 0, 0, 0], [0, sub - 1, sub - 1, sub - 1])
+    ens = EnsembleRun(ctx, 2,
+                      sub_domains=[dict(x=sub, y=sub, z=sub), None])
+    assert ens.masked
+    with ens.member(1) as c:
+        c.get_var("vel").set_all_elements_same(0.5)
+        seed_member(c, 1)
+    ens.run(0, STEPS - 1)
+    assert ens.batched_reason == "", ens.batched_reason
+
+    got = np.asarray(ctx.get_var("pressure").get_elements_in_slice(
+        [STEPS, 0, 0, 0], [STEPS, sub - 1, sub - 1, sub - 1]))
+    want = np.asarray(solo_sub.get_var("pressure").get_elements_in_slice(
+        [STEPS, 0, 0, 0], [STEPS, sub - 1, sub - 1, sub - 1]))
+    assert np.array_equal(got, want), \
+        f"masked member diverged (maxdiff {np.abs(got - want).max()})"
+    with ens.member(1) as c:
+        assert_states_equal(full_snap, state_snapshot(c),
+                            "full-domain co-member")
+
+
+def test_masked_sub_domain_requires_jit(env):
+    ctx = make_ctx(env, "pallas")
+    with pytest.raises(YaskException, match="mask"):
+        EnsembleRun(ctx, 2, sub_domains=[dict(x=12, y=12, z=12), None])
+
+
 def test_vmapped_failure_degrades_to_sequential(env, monkeypatch):
     n = 2
     seq = []

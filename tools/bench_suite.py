@@ -13,7 +13,11 @@ of ``bench.py``:
 * awp, domain-decomposed with measured halo fraction (multi-device);
 * ensemble batched-vs-sequential A/B (N instances as one vmapped
   program vs N fresh contexts each paying its own compile — the
-  parameter-sweep regime; bit-identity gated per member).
+  parameter-sweep regime; bit-identity gated per member);
+* serving-layer A/Bs: same-geometry micro-batching, cross-profile
+  shape-bucket co-batching (mixed geometries on one ladder rung,
+  masked sub-domain runs bit-identical to solo), and the
+  streaming/preemption short-request p99 win under mixed traffic.
 
 Every section is independent (a failure emits an error line and the
 suite continues), pallas numbers are correctness-gated against the jit
@@ -687,6 +691,224 @@ def run_suite(fac, env, budget_secs=None):
              tenants=N, occupancy=occ, seq_secs=round(t_seq, 3),
              serve_secs=round(t_srv, 3))
 
+    def serve_bucket_ab():
+        # Cross-PROFILE serving A/B: N tenants on >=3 DISTINCT
+        # geometries, all mapping to ONE bucket-ladder rung.  The
+        # sequential arm is N fresh solo contexts — each geometry its
+        # own prepared context, each member its own compile (memo
+        # cleared, disk cache off): the no-server cost of a
+        # mixed-geometry tenant population, and the bit-identity
+        # oracle.  The serve arm opens every session with
+        # ``bucket=True``: the planner hosts each tenant as a masked
+        # sub-domain of the shared rung profile and the scheduler
+        # rides ALL of them as one vmapped EnsembleRun — one compile,
+        # occupancy N, despite no two tenants necessarily sharing a
+        # geometry.  Gate: every tenant's outputs bit-identical to its
+        # solo twin over its OWN domain (extract_outputs slices the
+        # sub-domain back out of the bucket state).  The
+        # SERVE_BUCKET_SPEEDUP_FLOOR (1.5x) sentinel rule is
+        # CPU-scoped.
+        import numpy as np
+        from yask_tpu import cache as ccache
+        from yask_tpu.serve import StencilServer, bucket_for
+        from yask_tpu.serve.scheduler import extract_outputs
+        try:
+            N = int(os.environ.get("YT_BENCH_ENSEMBLE", "8"))
+        except ValueError:
+            N = 8
+        if N < 2:
+            return
+        # three distinct geometries on one rung (24 off-TPU, 48 on):
+        # the ladder's 8-multiples keep every sub-domain
+        # sublane-aligned for free.
+        gs_cycle = (40, 44, 48) if on_tpu else (20, 22, 24)
+        gs = [gs_cycle[i % len(gs_cycle)] for i in range(N)]
+        rung = bucket_for(max(gs))
+
+        def seed_arr(i, gi):
+            rng = np.random.RandomState(3000 + i)
+            return (rng.rand(1, gi, gi, gi).astype(np.float32)
+                    - 0.5) * 0.1
+
+        def solo_arm():
+            ctxs = []
+            for i, gi in enumerate(gs):
+                ctx = build(fac, env, "iso3dfd", 2, gi, "jit")
+                ctx.get_var("pressure").set_elements_in_slice(
+                    seed_arr(i, gi), [0, 0, 0, 0],
+                    [0, gi - 1, gi - 1, gi - 1])
+                ctxs.append(ctx)
+            t0s = time.perf_counter()
+            for ctx in ctxs:
+                ccache.clear_memo()   # each geometry+member: own compile
+                ctx.run_solution(0, steps - 1)
+            t = time.perf_counter() - t0s
+            outs = [extract_outputs(ctx) for ctx in ctxs]
+            del ctxs
+            return t, outs
+
+        def bucket_arm():
+            srv = StencilServer(window_secs=0.1, max_batch=N,
+                                preflight=False)
+            sids = []
+            for i, gi in enumerate(gs):
+                sid = srv.open_session(stencil="iso3dfd", radius=2,
+                                       g=gi, mode="jit", wf=2,
+                                       bucket=True)
+                b = srv.session_bucket(sid)
+                if b.get("decision") != "bucketed":
+                    raise RuntimeError(
+                        f"tenant {i} g={gi} did not bucket: {b}")
+                srv.init_vars(sid)
+                with srv.scheduler.session_ctx(sid) as c:
+                    c.get_var("pressure").set_elements_in_slice(
+                        seed_arr(i, gi), [0, 0, 0, 0],
+                        [0, gi - 1, gi - 1, gi - 1])
+                sids.append(sid)
+            ccache.clear_memo()
+            t0b = time.perf_counter()
+            handles = [srv.submit_run(sid, 0, steps - 1)
+                       for sid in sids]
+            resps = [srv.wait(h, timeout=600) for h in handles]
+            t = time.perf_counter() - t0b
+            occ = max((r.batch for r in resps), default=0)
+            srv.shutdown()
+            for r in resps:
+                if not r.ok:
+                    raise RuntimeError(
+                        f"bucket arm request {r.rid}: {r.status} "
+                        f"{r.error}")
+            return t, resps, occ
+
+        saved = os.environ.pop("YT_COMPILE_CACHE", None)
+        try:
+            t_solo, solo_outs = solo_arm()
+            t_bkt, resps, occ = bucket_arm()
+        finally:
+            if saved is not None:
+                os.environ["YT_COMPILE_CACHE"] = saved
+        if occ < N:
+            raise RuntimeError(
+                f"bucketed tenants did not co-batch: occupancy {occ} "
+                f"< {N} (geometries {sorted(set(gs))} on rung {rung})")
+        # batch= alone is the INTENDED width; batched= proves the
+        # vmapped executable really ran (a missing batching rule
+        # degrades to sequential members and must not bank a speedup)
+        if not all(r.batched for r in resps):
+            raise RuntimeError(
+                "bucket arm degraded to sequential members — "
+                "speedup row withheld")
+        for i, (want, r) in enumerate(zip(solo_outs, resps)):
+            for n, a in want.items():
+                b = r.outputs[n]
+                if a.shape != b.shape or not np.array_equal(a, b):
+                    raise RuntimeError(
+                        f"bucketed tenant {i} (g={gs[i]}) var {n} not "
+                        "bit-identical to its solo twin")
+
+        def remeasure_ratio():
+            sv = os.environ.pop("YT_COMPILE_CACHE", None)
+            try:
+                ts, _ = solo_arm()
+                tb, _, _ = bucket_arm()
+                return ts / max(tb, 1e-12)
+            finally:
+                if sv is not None:
+                    os.environ["YT_COMPILE_CACHE"] = sv
+
+        emit(f"iso3dfd r=2 mixed-g {plat} serve-bucket{N}-speedup",
+             t_solo / max(t_bkt, 1e-12), "x",
+             remeasure=remeasure_ratio, tenants=N,
+             geometries=sorted(set(gs)), rung=rung, occupancy=occ,
+             solo_secs=round(t_solo, 3), bucket_secs=round(t_bkt, 3))
+
+    def serve_stream_ab():
+        # Streaming/preemption A/B under MIXED traffic: one long run
+        # plus a burst of 1-step requests submitted while it is in
+        # flight.  Blocking arm (flush_every=0): the shorts wait out
+        # the whole long run — their latency IS the long run.
+        # Streaming arm (flush_every=steps): the scheduler executes
+        # the long run in guarded chunks, preempts it at a chunk
+        # boundary when the shorts are pending, runs them, then
+        # re-queues the continuation — short-request p99 collapses to
+        # about one chunk.  Both arms are pre-warmed (compile excluded
+        # on both sides; the row tracks scheduling latency, not
+        # amortization) and the long run's final state must be
+        # BIT-identical across arms: jit chunked execution equals the
+        # whole-range run exactly, preemption included.  No sentinel
+        # floor — the pass criterion rides in the row.
+        import numpy as np
+        from yask_tpu.serve import StencilServer
+        # 3axis is a pure neighbor average — unconditionally stable,
+        # so the long run stays finite for hundreds of steps (iso3dfd
+        # amplifies and overflows fp32 within ~40 steps).
+        g = 96 if on_tpu else 64
+        T = 150 * steps         # long enough to dominate the window
+        nshort = 3
+
+        srv = StencilServer(window_secs=0.02, max_batch=8,
+                            preflight=False)
+
+        def mk():
+            sid = srv.open_session(stencil="3axis", radius=4, g=g,
+                                   mode="jit", wf=2)
+            srv.init_vars(sid)
+            return sid
+
+        # warm every chunk shape both arms will run (whole-range,
+        # cadence chunks, 1-step shorts)
+        srv.run(mk(), 0, T - 1, timeout=600)
+        srv.run(mk(), 0, T - 1, flush_every=steps, timeout=600)
+        srv.run(mk(), 0, 0, timeout=600)
+
+        def arm(flush):
+            long_sid = mk()
+            shorts = [mk() for _ in range(nshort)]
+            h_long = srv.submit_run(long_sid, 0, T - 1,
+                                    flush_every=flush)
+            time.sleep(0.05)   # window elapses; long run is in flight
+            hs = [srv.submit_run(s, 0, 0) for s in shorts]
+            rs = [srv.wait(h, timeout=600) for h in hs]
+            r_long = srv.wait(h_long, timeout=600)
+            for r in list(rs) + [r_long]:
+                if not r.ok:
+                    raise RuntimeError(
+                        f"stream arm request {r.rid}: {r.status} "
+                        f"{r.error}")
+            lat = [r.queue_secs + r.run_secs for r in rs]
+            return max(lat), r_long
+
+        p99_block, r_block = arm(0)
+        p99_stream, r_stream = arm(steps)
+        srv.shutdown()
+        if r_stream.preempted < 1:
+            raise RuntimeError(
+                "streaming arm was never preempted — the shorts did "
+                "not interleave (long run too fast for the window?)")
+        for n, a in r_block.outputs.items():
+            b = r_stream.outputs[n]
+            if not np.array_equal(a, b):
+                raise RuntimeError(
+                    f"preempted chunked long run diverged from the "
+                    f"blocking run on {n}")
+
+        def remeasure_ratio():
+            pb, _ = arm(0)
+            ps, _ = arm(steps)
+            return pb / max(ps, 1e-12)
+
+        emit(f"3axis r=4 {g}^3 {plat} serve-stream-p99-win",
+             p99_block / max(p99_stream, 1e-12), "x",
+             remeasure=remeasure_ratio,
+             criterion="short-request p99 with streaming+preemption "
+                       "< blocking p99",
+             criterion_met=bool(p99_stream < p99_block),
+             p99_block_ms=round(p99_block * 1e3, 1),
+             p99_stream_ms=round(p99_stream * 1e3, 1),
+             shorts=nshort, long_steps=T, flush_every=steps,
+             preempts=r_stream.preempted,
+             stream_events=len(r_stream.streams))
+
     def pipeline_fusion_ab():
         # Cross-solution pipeline-fusion A/B on the 3-stage RTM chain
         # (forward iso wave -> imaging correlation -> 3-point
@@ -784,6 +1006,8 @@ def run_suite(fac, env, budget_secs=None):
     section(sp_overlap, t0, budget_secs)
     section(ensemble_ab, t0, budget_secs)
     section(serve_batch_ab, t0, budget_secs)
+    section(serve_bucket_ab, t0, budget_secs)
+    section(serve_stream_ab, t0, budget_secs)
     section(pipeline_fusion_ab, t0, budget_secs)
     return list(ROWS)
 

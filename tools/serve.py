@@ -19,14 +19,26 @@ Ops::
     {"op": "read", "sid": ..., "var": ..., "first": [...], "last": [...]}
     {"op": "init", "sid": ...}          # init_solution_vars
     {"op": "prewarm", "sid": ..., "steps": 8}
-    {"op": "run", "sid": ..., "first": 0, "last": 3, "outputs": []}
+    {"op": "run", "sid": ..., "first": 0, "last": 3, "outputs": [],
+     "flush_every": 0, "stream_outputs": false}
     {"op": "run_many", "requests": [{"sid":..., "first":..., "last":...,
                                      "outputs": []}, ...]}
         # submit-all-then-wait-all: the shape that actually exercises
         # the micro-batching window
-    {"op": "metrics"} / {"op": "flush_metrics"}
+    {"op": "metrics"} / {"op": "flush_metrics"} / {"op": "cache_stats"}
     {"op": "close", "sid": ...}
     {"op": "shutdown"}
+
+``open`` takes an optional ``bucket`` (true/false/null = the
+``YT_SERVE_BUCKETING`` default) — shape-bucket co-batching per
+``yask_tpu/serve/buckets.py``.
+
+**Streaming**: a ``run``/``run_many`` with ``flush_every > 0`` emits
+interleaved ``{"stream": true, "id": ..., "sid": ..., "step": ...}``
+lines on the SAME connection as each chunk boundary flushes (with the
+partial interiors when ``stream_outputs`` is set), BEFORE the final
+response line.  Clients must collect/skip ``stream`` lines until a
+line without ``"stream"`` arrives — ``tools/serve_client.py`` does.
 
 Arrays cross the wire as ``{"shape": [...], "dtype": "float32",
 "data": [flat row-major floats]}``.  float32 values round-trip EXACTLY
@@ -66,6 +78,14 @@ def _decode_array(d: dict):
                       ).reshape(d.get("shape", [-1]))
 
 
+def _encode_stream_event(ev: dict) -> dict:
+    out = {"step": ev.get("step")}
+    if "outputs" in ev:
+        out["outputs"] = {k: _encode_array(v)
+                          for k, v in ev["outputs"].items()}
+    return out
+
+
 def _encode_response(resp) -> dict:
     out = {"ok": resp.ok, "rid": resp.rid, "session": resp.session,
            "status": resp.status, "batch": resp.batch,
@@ -80,23 +100,33 @@ def _encode_response(resp) -> dict:
         out["error"] = resp.error
     if resp.anomaly:
         out["anomaly"] = resp.anomaly
+    if resp.bucket:
+        out["bucket"] = resp.bucket
+    if resp.preempted:
+        out["preempted"] = int(resp.preempted)
+    if resp.streams:
+        out["streams"] = [_encode_stream_event(e) for e in resp.streams]
     return out
 
 
 class ServeFront:
     """Dispatch table from wire ops to server methods."""
 
+    #: ops that may emit interleaved ``{"stream": true}`` lines.
+    _STREAMING_OPS = ("run", "run_many")
+
     def __init__(self, server):
         self.server = server
         self.closing = threading.Event()
 
-    def handle(self, msg: dict) -> dict:
+    def handle(self, msg: dict, emit=None) -> dict:
         op = msg.get("op")
         fn = getattr(self, f"op_{op}", None)
         if fn is None:
             return {"ok": False, "error": f"unknown op {op!r}"}
         try:
-            out = fn(msg)
+            out = fn(msg, emit) if op in self._STREAMING_OPS \
+                else fn(msg)
         except Exception as e:  # noqa: BLE001 - the front must answer
             out = {"ok": False,
                    "error": f"{type(e).__name__}: {e}"}
@@ -109,7 +139,7 @@ class ServeFront:
             stencil=msg["stencil"], radius=msg.get("radius"),
             g=msg.get("g", 16), mode=msg.get("mode", "jit"),
             wf=int(msg.get("wf", 2)), options=msg.get("options", ""),
-            session=msg.get("session"))
+            session=msg.get("session"), bucket=msg.get("bucket"))
         return {"ok": True, "sid": sid}
 
     def op_fill(self, msg):
@@ -142,18 +172,47 @@ class ServeFront:
                             last_step=(None if m.get("last") is None
                                        else int(m["last"])),
                             outputs=tuple(m.get("outputs", ())),
-                            deadline_secs=float(m.get("deadline", 0.0)))
+                            deadline_secs=float(m.get("deadline", 0.0)),
+                            flush_every=int(m.get("flush_every", 0)),
+                            stream_outputs=bool(
+                                m.get("stream_outputs", False)))
 
-    def op_run(self, msg):
-        resp = self.server.request(self._req(msg),
-                                   timeout=msg.get("timeout"))
-        return _encode_response(resp)
+    @staticmethod
+    def _stream_hook(emit, sid, rid):
+        """The per-request flush hook: push one ``{"stream": true}``
+        line.  Defensive — a dropped client must cost the beacon, not
+        the run (the scheduler's flush policy, extended to the wire)."""
+        def push(ev):
+            line = {"stream": True, "sid": sid,
+                    **_encode_stream_event(ev)}
+            if rid is not None:
+                line["id"] = rid
+            try:
+                emit(line)
+            except Exception:  # noqa: BLE001
+                pass
+        return push
 
-    def op_run_many(self, msg):
+    def op_run(self, msg, emit=None):
+        req = self._req(msg)
+        hook = None
+        if emit is not None and req.flush_every > 0:
+            hook = self._stream_hook(emit, req.session, msg.get("id"))
+        h = self.server.submit(req, on_stream=hook)
+        return _encode_response(
+            self.server.wait(h, timeout=msg.get("timeout")))
+
+    def op_run_many(self, msg, emit=None):
         # submit EVERYTHING before waiting on anything — this is what
         # lands compatible requests inside one batching window
-        handles = [self.server.submit(self._req(m))
-                   for m in msg["requests"]]
+        handles = []
+        for m in msg["requests"]:
+            req = self._req(m)
+            hook = None
+            if emit is not None and req.flush_every > 0:
+                hook = self._stream_hook(emit, req.session,
+                                         msg.get("id"))
+            handles.append(self.server.submit(req, on_stream=hook))
         resps = [self.server.wait(h, timeout=msg.get("timeout"))
                  for h in handles]
         return {"ok": True,
@@ -161,6 +220,11 @@ class ServeFront:
 
     def op_metrics(self, msg):
         return {"ok": True, "metrics": self.server.metrics()}
+
+    def op_cache_stats(self, msg):
+        from yask_tpu.cache import cache_dir, stats
+        return {"ok": True, "stats": stats(),
+                "cache_dir": cache_dir()}
 
     def op_flush_metrics(self, msg):
         rows = self.server.flush_metrics()
@@ -176,7 +240,16 @@ class ServeFront:
 
 
 def _serve_stream(front: ServeFront, rfile, wfile) -> None:
-    """One JSON-lines conversation (stdio, or one socket client)."""
+    """One JSON-lines conversation (stdio, or one socket client).
+    Stream events fire from the scheduler's worker thread while this
+    thread blocks in ``wait``, so all writes go through one lock."""
+    wlock = threading.Lock()
+
+    def emit(obj: dict) -> None:
+        with wlock:
+            wfile.write(json.dumps(obj, sort_keys=True) + "\n")
+            wfile.flush()
+
     for line in rfile:
         line = line.strip()
         if not line:
@@ -186,9 +259,8 @@ def _serve_stream(front: ServeFront, rfile, wfile) -> None:
         except ValueError as e:
             out = {"ok": False, "error": f"bad JSON: {e}"}
         else:
-            out = front.handle(msg)
-        wfile.write(json.dumps(out, sort_keys=True) + "\n")
-        wfile.flush()
+            out = front.handle(msg, emit=emit)
+        emit(out)
         if front.closing.is_set():
             return
 

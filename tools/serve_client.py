@@ -61,6 +61,11 @@ class ServeClient:
         self._proc = proc
         self._sock = sock
         self._next_id = 0
+        #: interleaved ``{"stream": true}`` lines collected during
+        #: streaming run/run_many calls (decoded), oldest first.
+        self.stream_events: List[Dict] = []
+        #: optional callable(event) fired as each stream line arrives.
+        self.on_stream = None
 
     # ------------------------------------------------------ transports
 
@@ -90,16 +95,30 @@ class ServeClient:
 
     def call(self, op: str, **fields) -> Dict:
         """One op round-trip; raises :class:`ServeClientError` on a
-        transport drop or an ``ok: false`` answer."""
+        transport drop or an ``ok: false`` answer.  Interleaved
+        ``{"stream": true}`` lines (streaming runs) are collected
+        onto :attr:`stream_events` — and forwarded to
+        :attr:`on_stream` — until the final response line arrives."""
         msg = {"op": op, "id": self._next_id, **fields}
         self._next_id += 1
         self._w.write(json.dumps(msg) + "\n")
         self._w.flush()
-        line = self._r.readline()
-        if not line:
-            raise ServeClientError(
-                f"server closed the stream during op {op!r}")
-        out = json.loads(line)
+        while True:
+            line = self._r.readline()
+            if not line:
+                raise ServeClientError(
+                    f"server closed the stream during op {op!r}")
+            out = json.loads(line)
+            if out.get("stream"):
+                if "outputs" in out:
+                    out["outputs"] = {k: decode_array(v)
+                                      for k, v in out["outputs"].items()}
+                self.stream_events.append(out)
+                cb = self.on_stream
+                if cb is not None:
+                    cb(out)
+                continue
+            break
         if not out.get("ok"):
             raise ServeClientError(
                 out.get("error") or f"op {op!r} failed: {out}")
@@ -109,10 +128,11 @@ class ServeClient:
 
     def open(self, stencil: str, radius: Optional[int] = None, g=16,
              mode: str = "jit", wf: int = 2, options: str = "",
-             session: Optional[str] = None) -> str:
+             session: Optional[str] = None,
+             bucket: Optional[bool] = None) -> str:
         return self.call("open", stencil=stencil, radius=radius, g=g,
                          mode=mode, wf=wf, options=options,
-                         session=session)["sid"]
+                         session=session, bucket=bucket)["sid"]
 
     def fill(self, sid: str, var: str, value: float) -> None:
         self.call("fill", sid=sid, var=var, value=float(value))
@@ -135,20 +155,30 @@ class ServeClient:
 
     def run(self, sid: str, first: int, last: Optional[int] = None,
             outputs: Sequence[str] = (),
-            timeout: Optional[float] = None) -> Dict:
+            timeout: Optional[float] = None,
+            flush_every: int = 0, stream_outputs: bool = False) -> Dict:
         out = self.call("run", sid=sid, first=first, last=last,
-                        outputs=list(outputs), timeout=timeout)
+                        outputs=list(outputs), timeout=timeout,
+                        flush_every=int(flush_every),
+                        stream_outputs=bool(stream_outputs))
         return self._decode_resp(out)
 
     def run_many(self, requests: Sequence[Tuple],
                  outputs: Sequence[str] = (),
                  timeout: Optional[float] = None) -> List[Dict]:
         """Submit-all-then-wait-all; ``requests`` is a sequence of
-        ``(sid, first, last)`` tuples.  Compatible requests co-batch
-        inside the server's window."""
-        reqs = [{"sid": sid, "first": first, "last": last,
+        ``(sid, first, last)`` or ``(sid, first, last, extra)``
+        tuples (``extra`` = dict of per-request fields like
+        ``flush_every`` / ``stream_outputs``).  Compatible requests
+        co-batch inside the server's window."""
+        reqs = []
+        for r in requests:
+            sid, first, last = r[0], r[1], r[2]
+            m = {"sid": sid, "first": first, "last": last,
                  "outputs": list(outputs)}
-                for sid, first, last in requests]
+            if len(r) > 3 and r[3]:
+                m.update(r[3])
+            reqs.append(m)
         out = self.call("run_many", requests=reqs, timeout=timeout)
         return [self._decode_resp(r) for r in out["responses"]]
 
@@ -156,10 +186,20 @@ class ServeClient:
     def _decode_resp(out: Dict) -> Dict:
         out["outputs"] = {k: decode_array(v)
                           for k, v in out.get("outputs", {}).items()}
+        for ev in out.get("streams", ()):
+            if "outputs" in ev:
+                ev["outputs"] = {k: decode_array(v)
+                                 for k, v in ev["outputs"].items()}
         return out
 
     def metrics(self) -> Dict:
         return self.call("metrics")["metrics"]
+
+    def cache_stats(self) -> Dict:
+        """The worker's process-wide compile-cache counters
+        (``yask_tpu.cache.stats()``) — ``lowerings == 0`` on a
+        warm-started worker is the fleet acceptance probe."""
+        return self.call("cache_stats")
 
     def flush_metrics(self) -> int:
         return self.call("flush_metrics")["rows"]

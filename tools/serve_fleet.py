@@ -1,0 +1,430 @@
+#!/usr/bin/env python
+"""A warm-cache serving FLEET: N ``tools/serve.py`` workers behind one
+JSON-lines front.
+
+Topology::
+
+    client  ──stdio/TCP──  serve_fleet.py  ──stdio pipes──  worker 0
+                                 │                          worker 1
+                                 │                          ...
+                           (routing table: sid -> worker)
+
+* **Workers** are plain ``tools/serve.py`` stdio children
+  (``tools/serve_client.py`` transport), each with its OWN journal
+  (``SERVE_JOURNAL.w<i>.jsonl`` — per-worker lifecycle evidence, and
+  how the affinity test proves a session never migrated) and a SHARED
+  on-disk compile cache (``YT_COMPILE_CACHE``): worker 0's compiles
+  land in the cache, so worker 1+'s first request deserializes with
+  ZERO lowerings (``cache_stats``) — the fleet's scale-out contract.
+* **Session affinity**: ``open`` places a session on one worker
+  (admission control below) and every later op for that sid routes to
+  the same worker — session state lives in worker memory, migration
+  would lose it.  The fleet namespaces session ids (``f0000...``) so
+  two workers can never hand out colliding ids.
+* **Admission control**: placement reads each worker's live metrics
+  (queue depth, open sessions — the same numbers the journal
+  occupancy rows carry); the least-loaded worker wins, and when every
+  worker's queue is past ``YT_FLEET_MAX_QUEUE`` (default 64) the op
+  is rejected instead of queued — saturation answers fast, it does
+  not time out slowly.  Routing decisions pass the ``fleet.route``
+  fault point (``YT_FAULT_PLAN`` injectable; a classified fault
+  rejects that op, it never kills the fleet).
+* **Streaming** passes through: a worker's interleaved
+  ``{"stream": true}`` lines are re-emitted to the fleet's client as
+  they arrive (per-worker pipes are serialized by a lock, so a
+  stream line can only belong to the in-flight call on that worker).
+
+The fleet front performs no device work itself — every op is a
+forwarded worker call over pipes; the guarded device sites live in the
+workers' serve package.
+
+Usage::
+
+    python tools/serve_fleet.py --workers 2 --cache-dir /tmp/ytcache
+    # then speak the tools/serve.py JSON-lines protocol on stdio, or
+    # --port for TCP.  Extra op: {"op": "fleet_stats"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.serve_client import ServeClient, ServeClientError
+
+
+def fleet_max_queue() -> int:
+    try:
+        return max(1, int(os.environ.get("YT_FLEET_MAX_QUEUE", "")
+                          or 64))
+    except ValueError:
+        return 64
+
+
+class FleetWorker:
+    """One spawned serve.py child + its pipe lock and journal path."""
+
+    def __init__(self, idx: int, client: ServeClient,
+                 journal_path: str):
+        self.idx = idx
+        self.client = client
+        self.journal_path = journal_path
+        self.lock = threading.Lock()  # serializes this worker's pipe
+        self.sessions: set = set()
+
+    def call(self, op: str, on_stream=None, **fields) -> Dict:
+        with self.lock:
+            prev = self.client.on_stream
+            self.client.on_stream = on_stream
+            try:
+                out = self.client.call(op, **fields)
+            finally:
+                self.client.on_stream = prev
+        # the pipe-level request id is this worker-client's own; the
+        # fleet front re-stamps its client's id in handle()
+        out.pop("id", None)
+        return out
+
+    def occupancy(self) -> Dict:
+        """Live load numbers for admission (falls back to the local
+        session count when the worker cannot answer)."""
+        try:
+            m = self.call("metrics")["metrics"]
+            return {"queue_depth": int(m.get("queue_depth", 0)),
+                    "sessions": int(m.get("sessions", 0)),
+                    "completed": int(m.get("completed", 0))}
+        except (ServeClientError, OSError, ValueError):
+            return {"queue_depth": 0, "sessions": len(self.sessions),
+                    "completed": -1}
+
+
+class ServeFleet:
+    """The routing front: spawns the workers, owns the sid->worker
+    table, forwards ops."""
+
+    def __init__(self, n_workers: int = 2,
+                 cache_dir: Optional[str] = None,
+                 journal_dir: Optional[str] = None,
+                 worker_args: List[str] = (),
+                 env: Optional[Dict[str, str]] = None):
+        self.closing = threading.Event()
+        self._route_table: Dict[str, FleetWorker] = {}
+        self._lock = threading.RLock()
+        self._next_sid = 0
+        jdir = journal_dir or os.getcwd()
+        base_env = dict(os.environ if env is None else env)
+        if cache_dir:
+            base_env["YT_COMPILE_CACHE"] = cache_dir
+        self.cache_dir = base_env.get("YT_COMPILE_CACHE", "")
+        self.workers: List[FleetWorker] = []
+        for i in range(max(1, int(n_workers))):
+            jpath = os.path.join(jdir, f"SERVE_JOURNAL.w{i}.jsonl")
+            wenv = dict(base_env)
+            wenv["YT_SERVE_JOURNAL"] = jpath
+            client = ServeClient.spawn(
+                extra_args=list(worker_args),
+                env=wenv, stderr=subprocess.DEVNULL)
+            self.workers.append(FleetWorker(i, client, jpath))
+
+    # --------------------------------------------------------- routing
+
+    def _route(self, sid: str) -> FleetWorker:
+        """Affinity: the worker that owns this session."""
+        from yask_tpu.resilience.faults import fault_point
+        fault_point("fleet.route")
+        with self._lock:
+            w = self._route_table.get(str(sid))
+        if w is None:
+            raise ServeClientError(
+                f"unknown fleet session {sid!r} (not opened through "
+                "this fleet, or already closed)")
+        return w
+
+    def _admit(self) -> FleetWorker:
+        """Placement for a new session: least-loaded worker by live
+        queue depth then session count; reject when the whole fleet is
+        past the queue bound (saturation answers fast)."""
+        from yask_tpu.resilience.faults import fault_point
+        fault_point("fleet.route")
+        occ = [(w, w.occupancy()) for w in self.workers]
+        bound = fleet_max_queue()
+        if all(o["queue_depth"] >= bound for _w, o in occ):
+            raise ServeClientError(
+                f"fleet saturated: every worker's queue depth >= "
+                f"{bound} (YT_FLEET_MAX_QUEUE)")
+        occ.sort(key=lambda t: (t[1]["queue_depth"],
+                                t[1]["sessions"], t[0].idx))
+        return occ[0][0]
+
+    # ------------------------------------------------------------- ops
+
+    def handle(self, msg: dict, emit=None) -> dict:
+        op = msg.get("op")
+        fn = getattr(self, f"op_{op}", None)
+        try:
+            if fn is not None:
+                out = fn(msg, emit)
+            elif "sid" in msg:
+                # any other session-scoped op: pure affinity forward
+                out = self._forward(msg, emit)
+            else:
+                out = {"ok": False, "error": f"unknown op {op!r}"}
+        except Exception as e:  # noqa: BLE001 - the front must answer
+            out = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        if "id" in msg:
+            out["id"] = msg["id"]
+        return out
+
+    def _forward(self, msg: dict, emit=None) -> dict:
+        w = self._route(msg["sid"])
+        return self._worker_call(w, msg, emit)
+
+    @staticmethod
+    def _worker_call(w: FleetWorker, msg: dict, emit=None) -> dict:
+        hook = None
+        if emit is not None:
+            def hook(ev):  # re-emit worker stream lines to our client
+                try:
+                    from tools.serve_client import encode_array
+                    line = dict(ev)
+                    if "outputs" in line:
+                        line["outputs"] = {
+                            k: encode_array(v)
+                            for k, v in line["outputs"].items()}
+                    if "id" in msg:
+                        line["id"] = msg["id"]
+                    emit(line)
+                except Exception:  # noqa: BLE001 - beacon only
+                    pass
+        fields = {k: v for k, v in msg.items() if k not in ("op", "id")}
+        return w.call(msg["op"], on_stream=hook, **fields)
+
+    def op_open(self, msg, emit=None):
+        w = self._admit()
+        with self._lock:
+            sid = msg.get("session") or f"f{self._next_sid:04d}"
+            self._next_sid += 1
+            if sid in self._route_table:
+                return {"ok": False,
+                        "error": f"fleet session {sid!r} already open"}
+        fields = {k: v for k, v in msg.items() if k not in ("op", "id")}
+        fields["session"] = sid
+        out = w.call("open", **fields)
+        with self._lock:
+            self._route_table[out["sid"]] = w
+            w.sessions.add(out["sid"])
+        out["worker"] = w.idx
+        return out
+
+    def op_close(self, msg, emit=None):
+        w = self._route(msg["sid"])
+        out = w.call("close", sid=msg["sid"])
+        with self._lock:
+            self._route_table.pop(msg["sid"], None)
+            w.sessions.discard(msg["sid"])
+        return out
+
+    def op_run_many(self, msg, emit=None):
+        """Split by owning worker, forward each shard concurrently
+        (submit-all-then-wait-all must reach each worker as one op to
+        land inside its batching window), reassemble in order."""
+        reqs = msg["requests"]
+        shards: Dict[int, List[int]] = {}
+        for i, m in enumerate(reqs):
+            w = self._route(m["sid"])
+            shards.setdefault(w.idx, []).append(i)
+        results: List[Optional[dict]] = [None] * len(reqs)
+        errs: List[str] = []
+
+        def run_shard(widx: int, idxs: List[int]) -> None:
+            w = self.workers[widx]
+            sub = {"op": "run_many",
+                   "requests": [reqs[i] for i in idxs]}
+            if "timeout" in msg:
+                sub["timeout"] = msg["timeout"]
+            if "id" in msg:
+                sub["id"] = msg["id"]
+            try:
+                out = self._worker_call(w, sub, emit)
+                for i, r in zip(idxs, out["responses"]):
+                    results[i] = r
+            except Exception as e:  # noqa: BLE001
+                errs.append(f"worker {widx}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=run_shard, args=(wi, ix))
+                   for wi, ix in shards.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            return {"ok": False, "error": "; ".join(errs)}
+        return {"ok": True, "responses": results}
+
+    def op_metrics(self, msg, emit=None):
+        """Aggregated fleet metrics + the per-worker breakdown."""
+        per = []
+        for w in self.workers:
+            try:
+                m = w.call("metrics")["metrics"]
+            except Exception as e:  # noqa: BLE001
+                m = {"error": f"{type(e).__name__}: {e}"}
+            m["worker"] = w.idx
+            per.append(m)
+        agg = {"queue_depth": sum(m.get("queue_depth", 0) for m in per),
+               "sessions": sum(m.get("sessions", 0) for m in per),
+               "completed": sum(m.get("completed", 0) for m in per),
+               "workers": per}
+        return {"ok": True, "metrics": agg}
+
+    def op_fleet_stats(self, msg, emit=None):
+        rows = []
+        for w in self.workers:
+            row = {"worker": w.idx, "journal": w.journal_path,
+                   "sessions": sorted(w.sessions),
+                   **w.occupancy()}
+            try:
+                cs = w.call("cache_stats")
+                row["cache"] = cs.get("stats", {})
+                row["cache_dir"] = cs.get("cache_dir")
+            except Exception as e:  # noqa: BLE001
+                row["cache"] = {"error": f"{type(e).__name__}: {e}"}
+            rows.append(row)
+        return {"ok": True, "cache_dir": self.cache_dir,
+                "workers": rows}
+
+    def op_cache_stats(self, msg, emit=None):
+        """Per-worker compile-cache counters (warm-start evidence)."""
+        out = {}
+        for w in self.workers:
+            try:
+                out[str(w.idx)] = w.call("cache_stats").get("stats", {})
+            except Exception as e:  # noqa: BLE001
+                out[str(w.idx)] = {"error": f"{type(e).__name__}: {e}"}
+        return {"ok": True, "stats": out}
+
+    def op_flush_metrics(self, msg, emit=None):
+        n = 0
+        for w in self.workers:
+            try:
+                n += int(w.call("flush_metrics").get("rows", 0))
+            except Exception:  # noqa: BLE001
+                pass
+        return {"ok": True, "rows": n}
+
+    def op_shutdown(self, msg, emit=None):
+        self.closing.set()
+        return {"ok": True}
+
+    # ------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        for w in self.workers:
+            try:
+                with w.lock:
+                    w.client.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _serve_stream(fleet: ServeFleet, rfile, wfile) -> None:
+    wlock = threading.Lock()
+
+    def emit(obj: dict) -> None:
+        with wlock:
+            wfile.write(json.dumps(obj, sort_keys=True) + "\n")
+            wfile.flush()
+
+    for line in rfile:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            msg = json.loads(line)
+        except ValueError as e:
+            out = {"ok": False, "error": f"bad JSON: {e}"}
+        else:
+            out = fleet.handle(msg, emit=emit)
+        emit(out)
+        if fleet.closing.is_set():
+            return
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="N-worker stencil-serving fleet front")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--cache-dir", default=None,
+                    help="shared compile cache (YT_COMPILE_CACHE; "
+                         "workers 1+ warm-start from worker 0's "
+                         "compiles)")
+    ap.add_argument("--journal-dir", default=None,
+                    help="directory for per-worker journals "
+                         "(SERVE_JOURNAL.w<i>.jsonl; default: cwd)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="listen on TCP (default: stdio)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--window_ms", type=float, default=None)
+    ap.add_argument("--max_batch", type=int, default=None)
+    ap.add_argument("--no-preflight", action="store_true")
+    args = ap.parse_args(argv)
+
+    wargs: List[str] = []
+    if args.window_ms is not None:
+        wargs += ["--window_ms", str(args.window_ms)]
+    if args.max_batch is not None:
+        wargs += ["--max_batch", str(args.max_batch)]
+    if args.no_preflight:
+        wargs += ["--no-preflight"]
+
+    fleet = ServeFleet(n_workers=args.workers,
+                       cache_dir=args.cache_dir,
+                       journal_dir=args.journal_dir,
+                       worker_args=wargs)
+    try:
+        if args.port is not None:
+            import socket
+            srv = socket.create_server((args.host, args.port))
+            srv.settimeout(0.5)
+            sys.stderr.write(
+                f"serve_fleet: {len(fleet.workers)} workers on "
+                f"{args.host}:{srv.getsockname()[1]}\n")
+            sys.stderr.flush()
+            threads = []
+            try:
+                while not fleet.closing.is_set():
+                    try:
+                        conn, _addr = srv.accept()
+                    except socket.timeout:
+                        continue
+                    t = threading.Thread(
+                        target=_serve_stream,
+                        args=(fleet, conn.makefile("r", encoding="utf-8"),
+                              conn.makefile("w", encoding="utf-8")),
+                        daemon=True)
+                    t.start()
+                    threads.append(t)
+            finally:
+                srv.close()
+                for t in threads:
+                    t.join(timeout=2.0)
+        else:
+            sys.stderr.write(
+                f"serve_fleet: {len(fleet.workers)} workers ready "
+                "(stdio)\n")
+            sys.stderr.flush()
+            _serve_stream(fleet, sys.stdin, sys.stdout)
+    finally:
+        fleet.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,7 +70,7 @@ MATRIX = [
 
 STAGES = ("smoke", "validate", "chunk_abs", "tune_bench",
           "compile_cache_ab", "ensemble_ab", "pipeline_fusion_ab",
-          "serving", "compile_time")
+          "serving", "serving_bucket", "compile_time")
 
 
 def matrix_cases():
@@ -1214,6 +1214,126 @@ def main(argv=None) -> int:
                        if mismatches else [])}
         return {}
 
+    def serving_bucket_case():
+        """Cross-profile bucketed co-batch A/B on the real backend:
+        tenants on THREE different geometries ride one ladder rung as
+        masked sub-domains of a shared bucket profile (one vmapped
+        ensemble execution) vs per-tenant solo contexts each paying
+        their own compile.  Bit-identity of every tenant to its solo
+        twin is the gate — the masked step runs as a chained pair of
+        select-free executables exactly so this holds on any backend;
+        this stage is that claim's first trial on real Mosaic-adjacent
+        XLA:TPU.  A degrade to sequential members (batched=False)
+        banks as an anomaly, never as a speedup."""
+        from yask_tpu import cache as ccache
+        from yask_tpu.serve import StencilServer
+        from yask_tpu.serve.buckets import bucket_for
+        from yask_tpu.serve.scheduler import extract_outputs
+        N = 4
+        # three distinct geometries that share ONE ladder rung (128 /
+        # 32) — mixed rungs group into separate bucket profiles and
+        # the A/B would measure two half-batches instead of one
+        cycle = (120, 124, 128) if plat == "tpu" else (28, 30, 32)
+        gs = [cycle[i % len(cycle)] for i in range(N)]
+        rung = bucket_for(max(gs))
+        steps_s = 4
+
+        def seed_arr(i, gi):
+            rng = np.random.RandomState(800 + i)
+            return (rng.rand(1, gi, gi, gi).astype(np.float32)
+                    - 0.5) * 0.1
+
+        saved = os.environ.pop("YT_COMPILE_CACHE", None)
+        try:
+            seq_outs = []
+            t0s = time.perf_counter()
+            for i, gi in enumerate(gs):
+                c = build(fac, env, "iso3dfd", "jit", gi, 2, wf=2)
+                c.get_var("pressure").set_elements_in_slice(
+                    seed_arr(i, gi), [0, 0, 0, 0],
+                    [0, gi - 1, gi - 1, gi - 1])
+                ccache.clear_memo()   # each geometry = its own compile
+                c.run_solution(0, steps_s - 1)
+                seq_outs.append(extract_outputs(c))
+                del c
+            t_seq = time.perf_counter() - t0s
+
+            srv = StencilServer(window_secs=0.1, max_batch=N,
+                                preflight=False)
+            sids = []
+            for i, gi in enumerate(gs):
+                sid = srv.open_session(stencil="iso3dfd", radius=2,
+                                       g=gi, mode="jit", wf=2,
+                                       bucket=True)
+                b = srv.session_bucket(sid)
+                if b["decision"] != "bucketed":
+                    raise RuntimeError(
+                        f"g={gi} not bucketed: {b}")
+                srv.init_vars(sid)
+                with srv.scheduler.session_ctx(sid) as c:
+                    c.get_var("pressure").set_elements_in_slice(
+                        seed_arr(i, gi), [0, 0, 0, 0],
+                        [0, gi - 1, gi - 1, gi - 1])
+                sids.append(sid)
+            ccache.clear_memo()
+            t0b = time.perf_counter()
+            handles = [srv.submit_run(sid, 0, steps_s - 1)
+                       for sid in sids]
+            resps = [srv.wait(h, timeout=600) for h in handles]
+            t_bkt = time.perf_counter() - t0b
+            occ = max((r.batch for r in resps), default=0)
+            degraded = sum(1 for r in resps
+                           if r.ok and r.batch > 1 and not r.batched)
+            srv.shutdown()
+        finally:
+            if saved is not None:
+                os.environ["YT_COMPILE_CACHE"] = saved
+        bad_resps = [r.rid for r in resps if not r.ok]
+        first = next((r for r in resps if r.ok), None)
+        probe = (next(iter(first.outputs.values()))
+                 if first and first.outputs else np.zeros(1))
+        sanity = check_output(
+            maybe_corrupt("session.serve_bucket_result",
+                          np.asarray(probe)))
+        mismatches = 0
+        if sanity["ok"]:   # corrupt serve arm: comparison withheld
+            for want, r in zip(seq_outs, resps):
+                if not r.ok:
+                    continue
+                for n, a in want.items():
+                    if (a.shape != r.outputs[n].shape
+                            or not np.array_equal(a, r.outputs[n])):
+                        mismatches += 1
+        line = {"metric": f"iso3dfd r=2 mixed-g {plat} "
+                          f"serve-bucket{N}-speedup",
+                "value": round(t_seq / max(t_bkt, 1e-12), 4),
+                "unit": "x", "platform": plat, "tenants": N,
+                "geometries": sorted(set(gs)), "rung": rung,
+                "occupancy": occ, "degraded": degraded,
+                "seq_secs": round(t_seq, 3),
+                "bucket_secs": round(t_bkt, 3),
+                "failed": len(bad_resps), "mismatches": mismatches}
+        log("serving_bucket", **line,
+            **({"anomalies": sanity["anomalies"]}
+               if not sanity["ok"] else {}))
+        if should_bank:
+            record(line, sanity=sanity)
+        if not sanity["ok"]:
+            return {"outcome": "anomaly",
+                    "anomalies": sanity["anomalies"]}
+        anomalies = []
+        if bad_resps:
+            anomalies.append(f"serve-failed:{len(bad_resps)}")
+        if mismatches:
+            anomalies.append(f"bucket-mismatch:{mismatches}")
+        if occ < N:
+            anomalies.append(f"no-cobatch:occupancy-{occ}")
+        if degraded:
+            anomalies.append(f"degraded-sequential:{degraded}")
+        if anomalies:
+            return {"outcome": "anomaly", "anomalies": anomalies}
+        return {}
+
     rc = 0
     try:
         if "smoke" in stages:
@@ -1256,6 +1376,8 @@ def main(argv=None) -> int:
                             pipeline_fusion_case)
         if "serving" in stages:
             runner.run_case("serving", "", serving_case)
+        if "serving_bucket" in stages:
+            runner.run_case("serving_bucket", "", serving_bucket_case)
 
         # 5b) quick sessions validate AFTER the perf stages are banked
         if quick and "validate" in stages:

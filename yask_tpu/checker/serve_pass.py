@@ -17,14 +17,23 @@ Rules (catalog in ``docs/checking.md``):
   identity (mode + pallas-variant key) requests must share to group —
   two profiles with mismatched variant keys never co-batch even at
   the same geometry.
+* ``SERVE-BUCKET-INELIGIBLE`` — the profile co-batches same-geometry
+  requests but can NOT host masked sub-domain tenants
+  (:func:`~yask_tpu.serve.buckets.bucket_cobatch_feasible` — the ONE
+  definition the open-session decision also consults): sessions at
+  other geometries will decline onto exact profiles and never share
+  this profile's executions (info — the server still answers; the
+  structured decline reason also rides every batched journal row).
+  When bucket hosting IS feasible, an info records the bucket-ladder
+  rung the profile geometry maps to.
 * ``SERVE-CACHE-COLD`` — ``YT_COMPILE_CACHE`` is unset for a server
   launch: warm restart is the serving layer's availability story (a
   restarted server answers its first request with zero lowerings),
   and without the disk cache every restart re-traces and re-lowers
   every profile (warn).
 
-Pure host work: a mode property and an environment read — no plan,
-no execution.
+Pure host work: a mode property, an equation scan, and an environment
+read — no plan, no execution.
 """
 
 from __future__ import annotations
@@ -61,6 +70,29 @@ def check_serve(report: CheckReport, ctx) -> None:
                    "profiles with different variant keys never share "
                    "a vmapped execution",
                    detail={"mode": mode, "variant_key": variant})
+
+    from yask_tpu.serve.buckets import (bucket_cobatch_feasible,
+                                        bucket_for)
+    bok, bwhy = bucket_cobatch_feasible(ctx)
+    if ok and not bok:
+        report.add("SERVE-BUCKET-INELIGIBLE", "info",
+                   f"profile co-batches same-geometry requests but "
+                   f"cannot host masked sub-domain tenants: {bwhy} — "
+                   "mixed-geometry sessions decline onto exact "
+                   "profiles",
+                   detail={"mode": mode, "reason": bwhy})
+    elif ok and bok:
+        try:
+            gs = {d: int(v) for d, v
+                  in opts.global_domain_sizes.items()}
+            rungs = {d: bucket_for(v) for d, v in gs.items()}
+        except Exception:  # noqa: BLE001 - identity note must not fail
+            gs, rungs = {}, {}
+        report.add("SERVE-BUCKET-INELIGIBLE", "info",
+                   "profile can host masked sub-domain tenants; "
+                   "sessions opened at smaller geometries on the same "
+                   "bucket rung co-batch with it bit-identically",
+                   detail={"mode": mode, "g": gs, "rung": rungs})
 
     from yask_tpu.cache import cache_dir
     if not cache_dir():

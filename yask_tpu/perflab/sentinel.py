@@ -151,6 +151,22 @@ ENSEMBLE_SPEEDUP_FLOOR = 2.0
 #: hardware.
 SERVE_BATCH_SPEEDUP_FLOOR = 1.5
 
+#: PROVISIONAL floor for the cross-PROFILE shape-bucket serving A/B
+#: (bench_suite ``serve-bucket8-speedup``: 8 tenants across >=3
+#: DISTINCT geometries through ONE server with bucketing ON — all
+#: hosted on one bucket-rung profile, co-batched masked — vs the same
+#: traffic with bucketing OFF, where each geometry pays its own
+#: prepared profile and only same-geometry requests share a batch).
+#: The win is compile amortization across geometries (G profiles ->
+#: 1) plus occupancy (three small batches -> one big one); the CPU
+#: proxy measures the compile leg.  Bit-identity against solo oracles
+#: gates the row before any timing counts.  The failure class this
+#: guards: open-session silently declining feasible tenants (every
+#: session "exact" -> the arms converge toward 1x) or the masked
+#: vmapped path degrading to sequential members.  CPU-scoped;
+#: re-base on hardware.
+SERVE_BUCKET_SPEEDUP_FLOOR = 1.5
+
 #: PROVISIONAL floor for the cross-solution pipeline-fusion A/B
 #: (bench_suite ``pipeline-fusion-speedup``: the 3-stage RTM chain —
 #: forward iso wave, imaging correlation, 3-point smoothing — as ONE
@@ -192,6 +208,10 @@ DEFAULT_RULES: List[GuardRule] = [
     GuardRule(name="serve-batch-speedup-floor",
               pattern="serve-batch",
               floor=SERVE_BATCH_SPEEDUP_FLOOR, rel_tol=0.25,
+              platforms=("cpu",)),
+    GuardRule(name="serve-bucket-speedup-floor",
+              pattern="serve-bucket",
+              floor=SERVE_BUCKET_SPEEDUP_FLOOR, rel_tol=0.25,
               platforms=("cpu",)),
     GuardRule(name="pipeline-fusion-floor",
               pattern="pipeline-fusion",

@@ -7,39 +7,71 @@ conditioning never diverges: written (state) vars get a position-dependent
 sequence; read-only coefficient vars get values near 1 with small
 variation — safe as divisors (1/ρ forms) and mild as multipliers so deep
 fp32 expression trees stay out of the cancellation regime.
+
+``sub_sizes`` (serve-side shape bucketing) restricts the fill to the
+low-corner sub-domain and — critically — generates the SAME values a
+solo context at those sizes would: the value sequence is laid out over
+the sub-domain shape, not the host geometry's, so a bucketed tenant
+and its solo oracle start bit-identical.
 """
 
 from __future__ import annotations
 
+from typing import Dict, Optional
+
 import numpy as np
 
 
-def init_solution_vars(ctx, seed: float = 0.05) -> None:
+def _fill_interior(ctx, name: str, value_fn,
+                   sub_sizes: Optional[Dict[str, int]] = None) -> None:
+    """Zero the array, then fill the (sub-)interior with
+    ``value_fn(n)`` values laid out over the interior shape — interior
+    coordinates only, so differently-padded (or bucket-hosted)
+    contexts start identical."""
+    g = ctx._program.geoms[name]
+    for slot in range(len(ctx._state[name])):
+        def fill(a, s=slot):
+            idxs, ishape = [], []
+            for ax, (dn, kind) in enumerate(g.axes):
+                if kind == "domain":
+                    size = ctx._opts.global_domain_sizes[dn]
+                    if sub_sizes is not None:
+                        size = int(sub_sizes.get(dn, size))
+                    idxs.append(slice(g.origin[dn],
+                                      g.origin[dn] + size))
+                    ishape.append(size)
+                else:
+                    idxs.append(slice(None))
+                    ishape.append(a.shape[ax])
+            n = int(np.prod(ishape)) if ishape else 1
+            vals = value_fn(n, s)
+            out = np.zeros_like(a)
+            out[tuple(idxs)] = vals.reshape(ishape).astype(a.dtype) \
+                if ishape else vals.astype(a.dtype)[0]
+            return out
+        ctx._update_state_array(name, slot, fill)
+
+
+def init_solution_vars(ctx, seed: float = 0.05,
+                       sub_sizes: Optional[Dict[str, int]] = None
+                       ) -> None:
     ctx._materialize_state()   # sync any device-resident shard interiors
     written = {eq.lhs.var_name() for eq in ctx._soln.get_equations()}
     for i, name in enumerate(sorted(ctx.get_var_names())):
         if name in written:
-            ctx.get_var(name).set_elements_in_seq(seed * (1 + i % 3))
+            if sub_sizes is None:
+                ctx.get_var(name).set_elements_in_seq(seed * (1 + i % 3))
+            else:
+                # the set_elements_in_seq value law over the SUB shape
+                s0 = seed * (1 + i % 3)
+                _fill_interior(
+                    ctx, name,
+                    lambda n, s, s0=s0:
+                        (np.arange(n, dtype=np.float64) % 17 + 1.0)
+                        * s0 * (s + 1),
+                    sub_sizes)
         else:
-            g = ctx._program.geoms[name]
-            for slot in range(len(ctx._state[name])):
-                def fill(a):
-                    # interior-coordinate based, like set_elements_in_seq:
-                    # identical values whatever the pad geometry
-                    idxs, ishape = [], []
-                    for ax, (dn, kind) in enumerate(g.axes):
-                        if kind == "domain":
-                            size = ctx._opts.global_domain_sizes[dn]
-                            idxs.append(slice(g.origin[dn],
-                                              g.origin[dn] + size))
-                            ishape.append(size)
-                        else:
-                            idxs.append(slice(None))
-                            ishape.append(a.shape[ax])
-                    n = int(np.prod(ishape)) if ishape else 1
-                    vals = 1.0 + 0.01 * (np.arange(n) % 13)
-                    out = np.zeros_like(a)
-                    out[tuple(idxs)] = vals.reshape(ishape).astype(a.dtype) \
-                        if ishape else vals.astype(a.dtype)[0]
-                    return out
-                ctx._update_state_array(name, slot, fill)
+            _fill_interior(
+                ctx, name,
+                lambda n, s: 1.0 + 0.01 * (np.arange(n) % 13),
+                sub_sizes)

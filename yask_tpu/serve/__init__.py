@@ -22,15 +22,28 @@ the session down the PR 9 mode-degradation ladder instead of failing
 the tenant.  Serving metrics (queue depth, batch occupancy, p50/p99
 latency split queue/run, cache-hit tier) append PERF_LEDGER rows.
 
+Serving v2 adds **shape-bucket co-batching** (:mod:`.buckets`):
+sessions opened at different geometries are hosted on shared bucket-
+ladder rung profiles and ride ONE masked vmapped ensemble, bit-
+identical to their solo runs; **chunked streaming** (``flush_every``
+on the request: partial-result ``stream`` events at chunk boundaries,
+long runs preemptible between chunks so short requests interleave);
+and a **warm-cache fleet front** (``tools/serve_fleet.py``: N workers
+behind one JSON-lines front with session-affinity routing, admission
+control, and a shared on-disk compile cache).
+
 Front ends: the in-process :class:`~yask_tpu.serve.server.
 StencilServer` API, and the stdio/socket JSON-lines front in
-``tools/serve.py`` (client: ``tools/serve_client.py``).  See
-``docs/serving.md``.
+``tools/serve.py`` (client: ``tools/serve_client.py``; fleet:
+``tools/serve_fleet.py``).  See ``docs/serving.md``.
 """
 
 from yask_tpu.serve.api import (ServeRequest, ServeResponse,
+                                serve_bucketing_enabled,
                                 serve_deadline_secs, serve_max_batch,
                                 serve_window_secs)
+from yask_tpu.serve.buckets import (BucketDecision, bucket_cobatch_feasible,
+                                    bucket_for, bucket_ladder, plan_bucket)
 from yask_tpu.serve.journal import (SERVE_SCHEMA, SERVE_TERMINAL,
                                     ServeJournal, default_serve_journal_path)
 from yask_tpu.serve.registry import SessionRegistry
@@ -40,4 +53,6 @@ __all__ = ["ServeRequest", "ServeResponse", "StencilServer",
            "SessionRegistry", "ServeJournal", "SERVE_SCHEMA",
            "SERVE_TERMINAL", "default_serve_journal_path",
            "serve_window_secs", "serve_max_batch",
-           "serve_deadline_secs"]
+           "serve_deadline_secs", "serve_bucketing_enabled",
+           "BucketDecision", "bucket_ladder", "bucket_for",
+           "plan_bucket", "bucket_cobatch_feasible"]

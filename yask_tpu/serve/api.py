@@ -22,14 +22,17 @@ Env knobs (all optional; see ``docs/serving.md``):
   ``guarded_call`` (default 300; SIGALRM only fires on the main
   thread, so off-thread schedulers rely on fault classification —
   documented limitation);
-* ``YT_SERVE_JOURNAL``    — journal path override (serve/journal.py).
+* ``YT_SERVE_JOURNAL``    — journal path override (serve/journal.py);
+* ``YT_SERVE_BUCKETING``  — "0" disables shape-bucket co-batching at
+  ``open_session`` (default on; see ``yask_tpu/serve/buckets.py``);
+* ``YT_SERVE_BUCKETS``    — bucket-ladder rung override (buckets.py).
 """
 
 from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 DEFAULT_WINDOW_MS = 5.0
 DEFAULT_MAX_BATCH = 16
@@ -63,6 +66,13 @@ def serve_deadline_secs() -> float:
                                DEFAULT_DEADLINE_SECS))
 
 
+def serve_bucketing_enabled() -> bool:
+    """Shape-bucket co-batching default for ``open_session``
+    (``YT_SERVE_BUCKETING``; "0"/"off"/"false" disable)."""
+    return os.environ.get("YT_SERVE_BUCKETING", "1").strip().lower() \
+        not in ("0", "off", "false", "no")
+
+
 @dataclass
 class ServeRequest:
     """One tenant's "advance my session" request.
@@ -76,6 +86,16 @@ class ServeRequest:
     last_step: Optional[int] = None
     outputs: Tuple[str, ...] = ()
     deadline_secs: float = 0.0
+    #: flush cadence, steps: > 0 asks the scheduler to run the range
+    #: in chunks of this many steps, emitting a ``stream`` journal /
+    #: wire event at every chunk boundary — and makes the run
+    #: PREEMPTIBLE between chunks (short requests interleave).
+    #: 0 = single guarded execution over the whole range (v1 shape).
+    flush_every: int = 0
+    #: carry the partial written interiors on each stream event (off
+    #: by default — a stream event is a progress beacon, the payload
+    #: is opt-in because extraction costs a device sync per chunk).
+    stream_outputs: bool = False
 
     def steps(self) -> Tuple[int, int]:
         last = self.first_step if self.last_step is None \
@@ -115,6 +135,16 @@ class ServeResponse:
     outputs: Dict = field(default_factory=dict)
     #: sanity verdict details when status == "anomaly".
     anomaly: Dict = field(default_factory=dict)
+    #: the session's structured bucketing verdict (BucketDecision
+    #: detail dict; empty for pre-bucketing sessions).
+    bucket: Dict = field(default_factory=dict)
+    #: how many times this request was preempted between flush chunks
+    #: (0 = ran to completion in one scheduling turn).
+    preempted: int = 0
+    #: stream events flushed for this request, oldest first (each:
+    #: {"step": ..., "outputs": {...}?}) — the wire front forwards
+    #: them as they happen; the in-process response also keeps them.
+    streams: List[Dict] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:

@@ -1,0 +1,160 @@
+"""Shape-bucket planning: map tenant geometries onto a small ladder
+of shared bucket shapes so tenants on DIFFERENT domains co-batch.
+
+The r15 scheduler only groups requests whose sessions share one exact
+(profile, mode, variant) key, so two tenants at g=20 and g=24 each pay
+their own prepared context and always ride occupancy-1 executions.
+Bucketing closes that gap: a session opened at g=20 is hosted inside a
+bucket profile at the next ladder rung (g=24 here), runs as a
+*sub-domain* of the bucket geometry, and co-batches with every other
+tenant on the same rung — ONE vmapped :class:`~yask_tpu.runtime.
+ensemble.EnsembleRun` over bucket-padded RunStates.
+
+Bit-identity is the gate, and it is an invariant, not a tolerance:
+outside the tenant's sub-domain every cell is held identically ZERO
+after every step (the physical-boundary ghost-zero contract extended
+inward — pads AND the bucket remainder), so an interior point's
+neighborhood reads exactly what the solo run's ghost pads would hold.
+The masked step lives in :class:`~yask_tpu.runtime.ensemble.
+EnsembleRun` (``sub_domains=``); tenant sub-domains anchor at the LOW
+corner, so interior coordinates 0..d-1 mean the same thing in bucket
+and solo geometry and index-values-as-values stay bit-identical.
+
+Ladder policy: rungs are 8-multiples (VarGeom pads sublane origins /
+totals to 8 and lane totals to 128 in every mode, so a rung never
+costs extra physical padding beyond what the solo geometry already
+paid), roughly geometric with steps <= 1.5x — the worst-case padded
+volume a tenant pays for riding a bucket is bounded per dim.
+Override with ``YT_SERVE_BUCKETS`` (comma-separated rung list).
+
+:func:`bucket_cobatch_feasible` is the ONE feasibility definition —
+the registry's open-session decision, the scheduler, and the
+checker's serve pass all consult it (same contract as
+:func:`~yask_tpu.runtime.ensemble.ensemble_feasible`).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+#: default bucket rungs: 8-multiples (sublane-aligned in fp32 — see
+#: VarGeom), <=1.5x steps so bucket-padded volume stays bounded.
+DEFAULT_LADDER = (8, 16, 24, 32, 48, 64, 96, 128, 192, 256, 384, 512)
+
+
+def bucket_ladder() -> Tuple[int, ...]:
+    """The active rung ladder (``YT_SERVE_BUCKETS`` override)."""
+    raw = os.environ.get("YT_SERVE_BUCKETS", "").strip()
+    if not raw:
+        return DEFAULT_LADDER
+    try:
+        rungs = sorted({int(x) for x in raw.split(",") if x.strip()})
+    except ValueError:
+        return DEFAULT_LADDER
+    return tuple(r for r in rungs if r > 0) or DEFAULT_LADDER
+
+
+def bucket_for(g: int) -> Optional[int]:
+    """Smallest ladder rung >= ``g`` (None when g overtops the
+    ladder — such domains serve exact, they are past the
+    small-domain co-batching regime anyway)."""
+    g = int(g)
+    for rung in bucket_ladder():
+        if rung >= g:
+            return rung
+    return None
+
+
+@dataclass
+class BucketDecision:
+    """The structured per-session bucketing verdict — journaled on
+    every ``batched`` row so a decline is evidence, not a mystery.
+
+    ``decision`` is one of ``bucketed`` (session rides a bucket
+    profile as a sub-domain), ``exact`` (session is hosted at its own
+    geometry: already on a rung, past the ladder, or bucketing was
+    not requested), ``declined`` (bucketing was requested but the
+    solution cannot run masked — ``reason`` says why; the session
+    still opens, exact)."""
+    decision: str
+    reason: str = ""
+    g: int = 0
+    bucket: Optional[int] = None
+
+    def as_detail(self) -> Dict:
+        d = {"decision": self.decision, "g": self.g}
+        if self.bucket is not None:
+            d["bucket"] = self.bucket
+        if self.reason:
+            d["reason"] = self.reason
+        return d
+
+
+def bucket_cobatch_feasible(ctx) -> Tuple[bool, str]:
+    """Can sessions hosted on this prepared context run as masked
+    sub-domains of a shared bucket?  ``(ok, why)`` — the ONE
+    definition (registry decision, scheduler, checker serve pass).
+
+    Masked sub-domain runs interpose a zero-mask after EVERY step
+    inside the scanned jit chunk, so:
+
+    * the mode must be ``jit`` — pallas fuses wf_steps in-kernel
+      (no inter-step hook), and the sharded modes already fail
+      :func:`~yask_tpu.runtime.ensemble.ensemble_feasible`;
+    * no equation may carry an ``IF_DOMAIN`` condition: domain
+      conditions anchor to the BUCKET's bounds (e.g. a reflective
+      wall at ``x == last_index``), which is not where the tenant's
+      sub-domain ends — masked results would diverge from solo.
+      Step conditions (t-only) are position-free and stay exact.
+    """
+    from yask_tpu.runtime.ensemble import ensemble_feasible
+    ok, why = ensemble_feasible(ctx)
+    if not ok:
+        return False, why
+    mode = ctx._mode or ctx._opts.mode
+    if mode != "jit":
+        return False, (
+            f"mode '{mode}' fuses steps in-kernel; the sub-domain "
+            "zero-mask must interpose after every step, which only "
+            "the scanned jit chunk allows")
+    for eq in ctx._soln.get_equations():
+        if eq.cond is not None:
+            return False, (
+                f"equation writing '{eq.lhs.var_name()}' carries an "
+                "IF_DOMAIN condition anchored to the bucket's domain "
+                "bounds — a sub-domain tenant's boundary is elsewhere")
+    return True, ""
+
+
+def plan_bucket(ctx_probe, g: int, requested: bool) -> BucketDecision:
+    """The open-session bucketing verdict for a tenant geometry ``g``
+    given a prepared context at that geometry class (``ctx_probe`` may
+    be the exact-geometry context — feasibility is a property of the
+    solution + mode, not of the rung)."""
+    g = int(g)
+    if not requested:
+        return BucketDecision("exact", g=g,
+                              reason="bucketing not requested")
+    rung = bucket_for(g)
+    if rung is None:
+        return BucketDecision(
+            "exact", g=g,
+            reason=f"g={g} overtops the bucket ladder "
+                   f"{bucket_ladder()[-1]} — serving exact")
+    ok, why = bucket_cobatch_feasible(ctx_probe)
+    if not ok:
+        return BucketDecision("declined", g=g, reason=why)
+    if rung == g:
+        # already on a rung: host on the bucket profile anyway (so it
+        # co-batches with smaller tenants on the same rung) but no
+        # sub-domain masking is needed — full-domain member.
+        return BucketDecision("bucketed", g=g, bucket=rung,
+                              reason="exact rung")
+    return BucketDecision("bucketed", g=g, bucket=rung)
+
+
+# mask construction lives with the masked chunk (ONE definition in
+# the runtime layer; serve must not fork its own geometry walk).
+from yask_tpu.runtime.ensemble import sub_domain_masks  # noqa: E402,F401

@@ -5,7 +5,8 @@ Schema ``yask_tpu.serve/1`` — one row per request-lifecycle event::
     {"v": "yask_tpu.serve/1",
      "rid":     "r000007",             # request id
      "session": "tenant-3",
-     "event":   "received|batched|ok|anomaly|rejected|fault|degraded",
+     "event":   "received|batched|ok|anomaly|rejected|fault|degraded"
+                "|stream|preempted",
      "ts":      "2026-08-05T12:00:00Z",
      "detail":  {...}}                 # event-specific (batch size,
                                        # fault kind, ladder rung, ...)
@@ -14,7 +15,10 @@ Schema ``yask_tpu.serve/1`` — one row per request-lifecycle event::
 request ran to completion but its outputs were quarantined by the
 result-sanity guards — released to the tenant flagged, never banked
 clean); ``received`` / ``batched`` / ``fault`` / ``degraded`` are
-lifecycle evidence.  The ``batched`` rows carry the batch occupancy —
+lifecycle evidence; ``stream`` marks a partial-result flush at a
+chunk boundary (``detail.step`` = last completed step) and
+``preempted`` marks the run yielding the device between chunks
+(``detail.resume_at`` = the continuation's first step).  The ``batched`` rows carry the batch occupancy —
 the acceptance criterion "co-batchable requests actually batched"
 reads them.  Mechanics mirror
 :class:`yask_tpu.resilience.journal.SessionJournal` (append-only,
@@ -36,7 +40,7 @@ SERVE_JOURNAL_BASENAME = "SERVE_JOURNAL.jsonl"
 SERVE_TERMINAL = ("ok", "anomaly", "rejected")
 
 SERVE_EVENTS = ("received", "batched", "ok", "anomaly", "rejected",
-                "fault", "degraded")
+                "fault", "degraded", "stream", "preempted")
 
 
 def _repo_root() -> str:

@@ -19,6 +19,12 @@ This is the reference's "one linked kernel library, many
 ``yk_solution`` instances" process model with the compile cache as
 the library: registering a second tenant on an existing profile costs
 one zero-filled state allocation, zero compiles.
+
+Shape bucketing (v2): ``StencilServer.open_session`` may key the
+prepared context by BUCKET geometry instead of the tenant's exact one
+(``yask_tpu.serve.buckets``) — the session then carries ``sub_sizes``
+and rides masked sub-domain executions, so tenants at g=20 and g=24
+share one profile at the g=24 rung and co-batch.
 """
 
 from __future__ import annotations
@@ -84,13 +90,29 @@ class Profile:
 
 class Session:
     """One tenant: its profile, current (possibly degraded) mode, and
-    its own RunState under that mode's prepared context."""
+    its own RunState under that mode's prepared context.
 
-    def __init__(self, sid: str, profile: Profile):
+    A BUCKETED session (shape co-batching, ``yask_tpu.serve.buckets``)
+    is hosted on a profile at a LARGER ladder-rung geometry than the
+    tenant requested: ``sub_sizes`` holds the tenant's logical domain
+    sizes ({dim: size}, low-corner anchored) and every run masks the
+    state to that sub-domain — results stay bit-identical to a solo
+    run at the tenant geometry.  ``bucket`` keeps the structured
+    :class:`~yask_tpu.serve.buckets.BucketDecision` for journaling."""
+
+    def __init__(self, sid: str, profile: Profile,
+                 sub_sizes: Optional[Dict[str, int]] = None,
+                 bucket=None):
         self.sid = sid
         self.profile = profile
         self.mode = profile.base_mode
         self.run_state = profile.ctx.new_run_state()
+        #: tenant's logical domain sizes when bucket-hosted (None =
+        #: the session occupies the profile's full geometry).
+        self.sub_sizes = dict(sub_sizes) if sub_sizes else None
+        #: the BucketDecision that placed this session (None = the
+        #: pre-bucketing open path).
+        self.bucket = bucket
         #: ladder rungs this session has been walked down, in order.
         self.degrade_path: List[str] = []
 
@@ -137,7 +159,9 @@ class SessionRegistry:
             return prof
 
     def open_session(self, profile: Profile,
-                     session: Optional[str] = None) -> Session:
+                     session: Optional[str] = None,
+                     sub_sizes: Optional[Dict[str, int]] = None,
+                     bucket=None) -> Session:
         with self._lock:
             if session is None:
                 session = f"s{self._next_sid:04d}"
@@ -145,7 +169,8 @@ class SessionRegistry:
             if session in self._sessions:
                 raise YaskException(
                     f"serve session {session!r} already open")
-            s = Session(str(session), profile)
+            s = Session(str(session), profile, sub_sizes=sub_sizes,
+                        bucket=bucket)
             self._sessions[s.sid] = s
             return s
 

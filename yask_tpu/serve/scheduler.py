@@ -12,19 +12,35 @@ the RunState hoist.  The loop:
    session, up to ``YT_SERVE_MAX_BATCH``, and only when
    :func:`~yask_tpu.runtime.ensemble.ensemble_feasible` says the mode
    batches (the ONE feasibility definition; sharded modes serve
-   singly);
-3. execute: occupancy > 1 rides ONE vmapped
-   :class:`~yask_tpu.runtime.ensemble.EnsembleRun` over the sessions'
-   existing RunStates; occupancy 1 is a plain ``run_solution`` under
-   the session's state.  Both under ``guarded_call`` at the
-   ``serve.run`` fault site with the per-request deadline;
+   singly).  Bucketed sessions (``yask_tpu.serve.buckets``) share a
+   bucket-rung profile, so tenants on DIFFERENT logical domains carry
+   the same key and co-batch;
+3. execute: occupancy > 1 — or ANY bucketed member — rides ONE
+   vmapped :class:`~yask_tpu.runtime.ensemble.EnsembleRun` over the
+   sessions' existing RunStates (bucketed members pass their
+   ``sub_sizes`` as masked sub-domains); plain occupancy 1 is a
+   ``run_solution`` under the session's state.  Both under
+   ``guarded_call`` at the ``serve.run`` fault site with the
+   per-request deadline.  A request with ``flush_every > 0`` splits
+   the range into chunks: each chunk is guarded separately, a
+   ``stream`` event (journal + wire) flushes at every chunk boundary
+   (``serve.flush`` fault site, NON-fatal — a failed flush skips the
+   beacon, never the run), and between chunks the batch YIELDS to any
+   waiting request (``preempted`` journal event; the continuation
+   re-queues BEFORE any same-session pending so per-session FIFO
+   holds).  Short requests interleave with long streamed ones — the
+   p99 win the bench A/B measures;
 4. on a classified fault: roll each affected session back to its
-   pre-request snapshot and walk it down the mode-degradation ladder
-   (PR 9) — the tenant gets a degraded-mode answer, not an error.  A
-   shared breaker (manual recording, reset on recovery — consecutive
-   faults trip it) bounds runaway ladder walks;
-5. release: written interiors pass ``maybe_corrupt("serve.respond")``
-   + the result-sanity guards; a failed verdict releases the response
+   last committed chunk boundary (pre-request when nothing streamed)
+   and walk it down the mode-degradation ladder (PR 9) over the
+   REMAINING step range — the tenant gets a degraded-mode answer, not
+   an error.  Bucket-hosted sessions never degrade (masked sub-domain
+   runs are jit-only, and jit's ladder is empty by design).  A shared
+   breaker (manual recording, reset on recovery — consecutive faults
+   trip it) bounds runaway ladder walks;
+5. release: written interiors (the tenant's SUB-domain for bucketed
+   sessions) pass ``maybe_corrupt("serve.respond")`` + the
+   result-sanity guards; a failed verdict releases the response
    flagged ``anomaly`` (quarantined — never banked clean).
 
 Every lifecycle edge is journaled (schema ``yask_tpu.serve/1``).
@@ -53,11 +69,14 @@ from yask_tpu.utils.exceptions import YaskException
 MAX_SAMPLES = 4096
 
 
-def extract_outputs(ctx, names: Tuple[str, ...] = ()) -> Dict:
+def extract_outputs(ctx, names: Tuple[str, ...] = (),
+                    sub_sizes: Optional[Dict[str, int]] = None) -> Dict:
     """Newest-slot written interiors of the ACTIVE run state, by
     interior coordinates (the same geometry walk as the watchdog scan
     and ``compare_data``) — the response payload, and the oracle-side
-    extraction the bit-identity tests compare against."""
+    extraction the bit-identity tests compare against.  ``sub_sizes``
+    restricts the domain slices to a bucketed tenant's low-corner
+    sub-domain, so the payload is shaped exactly like the solo run's."""
     ctx._check_prepared()
     ctx._materialize_state()
     gsz = ctx._opts.global_domain_sizes
@@ -69,7 +88,9 @@ def extract_outputs(ctx, names: Tuple[str, ...] = ()) -> Dict:
         elif not g.is_written or g.is_scratch:
             continue
         idx = tuple(
-            slice(g.origin[dn], g.origin[dn] + gsz[dn])
+            slice(g.origin[dn], g.origin[dn]
+                  + (int(sub_sizes.get(dn, gsz[dn]))
+                     if sub_sizes else gsz[dn]))
             if kind == "domain" else slice(None)
             for dn, kind in g.axes)
         out[name] = np.asarray(ctx._state[name][-1][idx])
@@ -82,9 +103,13 @@ def extract_outputs(ctx, names: Tuple[str, ...] = ()) -> Dict:
 
 
 class _Pending:
-    """One queued request plus its rendezvous with the worker."""
+    """One queued request plus its rendezvous with the worker.  The
+    mutable accumulators survive preemption rounds (a preempted
+    request re-enters the queue as its own continuation)."""
 
-    __slots__ = ("req", "rid", "t_received", "done", "response")
+    __slots__ = ("req", "rid", "t_received", "done", "response",
+                 "run_secs", "compile_secs", "cache_hit", "preempts",
+                 "streams", "on_stream")
 
     def __init__(self, req: ServeRequest, rid: str):
         self.req = req
@@ -92,6 +117,14 @@ class _Pending:
         self.t_received = time.perf_counter()
         self.done = threading.Event()
         self.response: Optional[ServeResponse] = None
+        self.run_secs = 0.0
+        self.compile_secs = 0.0
+        self.cache_hit = ""
+        self.preempts = 0
+        self.streams: List[Dict] = []
+        #: optional callable(event_dict) — the wire front's push hook,
+        #: invoked on the worker thread at each flush.
+        self.on_stream = None
 
     def finish(self, resp: ServeResponse) -> None:
         self.response = resp
@@ -125,13 +158,17 @@ class BatchScheduler:
 
     # ------------------------------------------------------------ API
 
-    def submit(self, req: ServeRequest) -> _Pending:
+    def submit(self, req: ServeRequest, on_stream=None) -> _Pending:
         """Enqueue; returns the pending handle (wait on
-        ``handle.done`` or use :meth:`wait`)."""
+        ``handle.done`` or use :meth:`wait`).  ``on_stream`` is an
+        optional callable(event_dict) fired on the worker thread at
+        every flush — the wire front's push hook (attached HERE, not
+        after submit, so the first chunk's flush cannot race it)."""
         with self._cond:
             rid = f"r{self._next_rid:06d}"
             self._next_rid += 1
             p = _Pending(req, rid)
+            p.on_stream = on_stream
             self._journal.record(rid, req.session, "received",
                                  first=req.steps()[0],
                                  last=req.steps()[1])
@@ -234,6 +271,9 @@ class BatchScheduler:
         except YaskException:
             return None
         first, last = p.req.steps()
+        # bucketed sessions share a bucket-rung profile, so
+        # profile.key here IS the bucket key: tenants at different
+        # logical domains on the same rung carry equal keys and group
         return (sess.profile.key, sess.mode,
                 sess.profile.variant_key(sess.mode), first, last)
 
@@ -277,25 +317,74 @@ class BatchScheduler:
                              status="rejected", error=why)
 
     def _execute(self, batch: List[_Pending]) -> None:
+        """One scheduling turn for a collected batch: journal the
+        batching decision, then run the step range — whole when no
+        member streams, chunked at the smallest requested flush
+        cadence otherwise, yielding to waiting requests between
+        chunks."""
+        sessions = [self._registry.session(p.req.session)
+                    for p in batch]
+        first, last = batch[0].req.steps()
+        n = len(batch)
+        for p, sess in zip(batch, sessions):
+            detail = {"batch": n, "first": first, "last": last,
+                      "mode": sess.mode,
+                      "window_ms": round(self._window * 1000.0, 3)}
+            if sess.bucket is not None:
+                # the structured bucketing verdict rides every
+                # batched row: bucketed / exact / declined-why
+                detail["bucket"] = sess.bucket.as_detail()
+            if p.req.flush_every > 0:
+                detail["flush_every"] = int(p.req.flush_every)
+            self._journal.record(p.rid, p.req.session, "batched",
+                                 **detail)
+        cadences = [int(p.req.flush_every) for p in batch
+                    if p.req.flush_every > 0]
+        span = abs(last - first) + 1
+        cadence = min(cadences) if cadences else 0
+        if cadence <= 0 or cadence >= span:
+            self._execute_chunk(batch, sessions, first, last,
+                                final=True)
+            return
+        dirn = 1 if last >= first else -1
+        a = first
+        while True:
+            b = a + dirn * (cadence - 1)
+            if (dirn > 0 and b >= last) or (dirn < 0 and b <= last):
+                b = last
+            final = b == last
+            if not self._execute_chunk(batch, sessions, a, b,
+                                       final=final):
+                return  # terminal (released, recovered, or rejected)
+            self._flush_batch(batch, sessions, b)
+            if self._maybe_preempt(batch, b + dirn, last):
+                return  # continuation re-queued
+            a = b + dirn
+
+    def _execute_chunk(self, batch: List[_Pending],
+                       sessions: List[Session], first: int, last: int,
+                       *, final: bool) -> bool:
+        """Run one guarded chunk [first, last] for the batch.  Returns
+        True when the caller should continue with the next chunk;
+        False when every request reached a terminal state here."""
         from yask_tpu.resilience.checkpoint import extract_snapshot
         from yask_tpu.resilience.faults import Fault, fault_point
         from yask_tpu.resilience.guard import guarded_call
         from yask_tpu.runtime.ensemble import EnsembleRun
 
-        sessions = [self._registry.session(p.req.session)
-                    for p in batch]
-        first, last = batch[0].req.steps()
         ddl = min((p.req.deadline_secs or serve_deadline_secs())
                   for p in batch) or None
         n = len(batch)
+        masked = any(s.sub_sizes for s in sessions)
         t_start = time.perf_counter()
 
         with self._dev_lock:
             ctx = sessions[0].ctx
             compile0 = ctx._compile_secs
-            # pre-request rollback targets (donation consumes rings on
-            # the compiled paths — a faulted run has nothing else to
-            # restart from)
+            # rollback targets: the last committed chunk boundary
+            # (pre-request when nothing has run yet) — donation
+            # consumes rings on the compiled paths, a faulted chunk
+            # has nothing else to restart from
             snaps = {}
             for sess in sessions:
                 prev = ctx.set_run_state(sess.run_state)
@@ -303,12 +392,6 @@ class BatchScheduler:
                     snaps[sess.sid] = extract_snapshot(ctx)
                 finally:
                     ctx.set_run_state(prev)
-            for p in batch:
-                self._journal.record(
-                    p.rid, p.req.session, "batched", batch=n,
-                    first=first, last=last,
-                    mode=sessions[0].mode,
-                    window_ms=round(self._window * 1000.0, 3))
 
             batched = False
             fault: Optional[Fault] = None
@@ -316,12 +399,17 @@ class BatchScheduler:
                 # the batching decision's injection site: a classified
                 # fault here takes the same degrade path as serve.run
                 fault_point("serve.batch")
-                if n > 1:
+                if n > 1 or masked:
+                    # bucketed members run masked even at occupancy 1:
+                    # a sub-domain session's state is only correct
+                    # under the per-step sub-domain mask
                     ens = EnsembleRun(
-                        ctx, members=[s.run_state for s in sessions])
+                        ctx, members=[s.run_state for s in sessions],
+                        sub_domains=([s.sub_sizes for s in sessions]
+                                     if masked else None))
                     guarded_call(ens.run, first, last,
                                  site="serve.run", deadline_secs=ddl)
-                    batched = ens.batched_reason == ""
+                    batched = ens.batched_reason == "" and n > 1
                 else:
                     prev = ctx.set_run_state(sessions[0].run_state)
                     try:
@@ -335,10 +423,14 @@ class BatchScheduler:
             except YaskException as e:
                 for p in batch:
                     p.finish(self._reject(p, str(e)))
-                return
-            run_secs = time.perf_counter() - t_start
+                return False
+            chunk_secs = time.perf_counter() - t_start
             compile_secs = ctx._compile_secs - compile0
             cache_hit = ctx._last_cache_hit or "cold"
+            for p in batch:
+                p.run_secs += chunk_secs
+                p.compile_secs += compile_secs
+                p.cache_hit = cache_hit
 
             if fault is not None:
                 tripped = self._breaker.record(fault)
@@ -350,20 +442,109 @@ class BatchScheduler:
                         breaker_tripped=bool(tripped))
                 for p, sess in zip(batch, sessions):
                     p.finish(self._recover(p, sess, snaps[sess.sid],
-                                           fault, tripped))
-                return
+                                           fault, tripped, first,
+                                           last=batch[0].req.steps()[1]))
+                return False
 
+        if final:
+            now = time.perf_counter()
+            for p, sess in zip(batch, sessions):
+                p.finish(self._release(
+                    p, sess, batch=n, batched=batched,
+                    queue_secs=max(0.0, now - p.t_received
+                                   - p.run_secs),
+                    run_secs=p.run_secs,
+                    compile_secs=p.compile_secs,
+                    cache_hit=p.cache_hit))
+            return False
+        return True
+
+    # ------------------------------------------------ stream / preempt
+
+    def _flush_batch(self, batch: List[_Pending],
+                     sessions: List[Session], step_done: int) -> None:
+        """Emit a ``stream`` event for every streaming member at a
+        chunk boundary.  Flushes are guarded at the ``serve.flush``
+        site but NON-fatal: a classified fault skips this beacon and
+        the run continues — a tenant's answer must never be lost to
+        evidence I/O (the journal's own policy, applied to streams)."""
+        from yask_tpu.resilience.faults import Fault
+        from yask_tpu.resilience.guard import guarded_call
         for p, sess in zip(batch, sessions):
-            p.finish(self._release(
-                p, sess, batch=n, batched=batched,
-                queue_secs=t_start - p.t_received, run_secs=run_secs,
-                compile_secs=compile_secs, cache_hit=cache_hit))
+            if p.req.flush_every <= 0:
+                continue
+            try:
+                guarded_call(self._flush_one, p, sess, step_done,
+                             site="serve.flush")
+            except Fault as f:
+                self._journal.record(p.rid, sess.sid, "fault",
+                                     kind=f.kind, site="serve.flush",
+                                     nonfatal=True)
+
+    def _flush_one(self, p: _Pending, sess: Session,
+                   step_done: int) -> None:
+        from yask_tpu.resilience.faults import fault_point
+        fault_point("serve.flush")
+        ev: Dict = {"step": int(step_done)}
+        if p.req.stream_outputs:
+            with self._dev_lock:
+                ctx = sess.ctx
+                prev = ctx.set_run_state(sess.run_state)
+                try:
+                    ev["outputs"] = extract_outputs(
+                        ctx, tuple(p.req.outputs),
+                        sub_sizes=sess.sub_sizes)
+                finally:
+                    ctx.set_run_state(prev)
+        self._journal.record(p.rid, sess.sid, "stream",
+                             step=int(step_done),
+                             chunk=len(p.streams),
+                             outputs=sorted(ev.get("outputs", ())))
+        p.streams.append(ev)
+        cb = p.on_stream
+        if cb is not None:
+            cb(ev)
+
+    def _maybe_preempt(self, batch: List[_Pending], next_first: int,
+                       last: int) -> bool:
+        """Between chunks: if anyone is waiting, yield — re-queue the
+        whole batch as its own continuation (same co-batch on the
+        next turn: all members share the updated step range, hence
+        the batch key).  The continuation is inserted BEFORE any
+        pending request of the same session, so per-session FIFO
+        ordering is preserved; with no same-session pending it goes
+        to the tail, behind the requests it yielded to."""
+        from yask_tpu.resilience.faults import fault_point
+        with self._cond:
+            if self._shutdown or not self._pending:
+                return False
+            fault_point("serve.batch")
+            for p in batch:
+                p.req.first_step = int(next_first)
+                p.req.last_step = int(last)
+                p.preempts += 1
+                self._journal.record(p.rid, p.req.session, "preempted",
+                                     resume_at=int(next_first),
+                                     last=int(last))
+            sids = {p.req.session for p in batch}
+            pos = len(self._pending)
+            for idx, q in enumerate(self._pending):
+                if q.req.session in sids:
+                    pos = idx
+                    break
+            self._pending[pos:pos] = batch
+            self._cond.notify_all()
+            return True
+
+    # --------------------------------------------------------- recover
 
     def _recover(self, p: _Pending, sess: Session, snap: Dict,
-                 fault, tripped: bool) -> ServeResponse:
+                 fault, tripped: bool, first: int,
+                 last: int) -> ServeResponse:
         """Walk the session down the mode-degradation ladder from its
-        pre-request snapshot; the tenant gets a degraded-mode answer
-        unless the ladder (or the breaker) is exhausted."""
+        last committed snapshot, over the REMAINING step range; the
+        tenant gets a degraded-mode answer unless the ladder (or the
+        breaker) is exhausted."""
         from yask_tpu.resilience.checkpoint import (apply_snapshot,
                                                     degradation_ladder)
         from yask_tpu.resilience.faults import Fault
@@ -372,7 +553,13 @@ class BatchScheduler:
             return self._reject(
                 p, f"{fault.kind} at serve.run and the breaker is "
                    "tripped (repeated faults) — not degrading")
-        first, last = p.req.steps()
+        if sess.sub_sizes:
+            # masked sub-domain runs are a jit-only contract, and a
+            # ladder rung's geometry would not be the bucket's —
+            # bucket-hosted sessions reject instead of degrading
+            return self._reject(
+                p, f"{fault.kind} at serve.run on a bucket-hosted "
+                   "session (masked sub-domain runs do not degrade)")
         ddl = p.req.deadline_secs or serve_deadline_secs()
         last_err: Exception = fault
         t0 = time.perf_counter()
@@ -432,13 +619,17 @@ class BatchScheduler:
             rid=p.rid, session=sess.sid, batch=batch, batched=batched,
             mode=sess.mode, degraded=sess.degraded,
             queue_secs=queue_secs, run_secs=run_secs,
-            compile_secs=compile_secs, cache_hit=cache_hit)
+            compile_secs=compile_secs, cache_hit=cache_hit,
+            bucket=(sess.bucket.as_detail()
+                    if sess.bucket is not None else {}),
+            preempted=p.preempts, streams=list(p.streams))
         try:
             with self._dev_lock:
                 ctx = sess.ctx
                 prev = ctx.set_run_state(sess.run_state)
                 try:
-                    outs = extract_outputs(ctx, tuple(p.req.outputs))
+                    outs = extract_outputs(ctx, tuple(p.req.outputs),
+                                           sub_sizes=sess.sub_sizes)
                 finally:
                     ctx.set_run_state(prev)
         except YaskException as e:
@@ -450,7 +641,8 @@ class BatchScheduler:
             resp.status = "ok"
             self._journal.record(p.rid, sess.sid, "ok", batch=batch,
                                  batched=batched, mode=sess.mode,
-                                 degraded=sess.degraded)
+                                 degraded=sess.degraded,
+                                 preempted=p.preempts)
         else:
             # quarantined release: the tenant sees the data AND the
             # verdict; the journal/ledger never bank it clean (the r3
@@ -465,6 +657,8 @@ class BatchScheduler:
                 "status": resp.status, "batch": batch,
                 "batched": batched, "mode": sess.mode,
                 "degraded": sess.degraded,
+                "bucketed": bool(sess.sub_sizes),
+                "preempted": p.preempts,
                 "queue_secs": queue_secs, "run_secs": run_secs,
                 "compile_secs": compile_secs, "cache_hit": cache_hit})
             if len(self._samples) > MAX_SAMPLES:

@@ -74,12 +74,57 @@ class StencilServer:
     def open_session(self, stencil: str, radius: Optional[int] = None,
                      g=16, mode: str = "jit", wf: int = 2,
                      options: str = "",
-                     session: Optional[str] = None) -> str:
-        prof = self.registry.get_profile(stencil, radius, g, mode,
+                     session: Optional[str] = None,
+                     bucket: Optional[bool] = None) -> str:
+        """Open a tenant session.  ``bucket`` controls shape-bucket
+        co-batching: None = the ``YT_SERVE_BUCKETING`` default (on),
+        False = host exactly at ``g``, True = request bucketing.  A
+        bucketed session is hosted on a profile at the next bucket-
+        ladder rung >= g and runs as a masked sub-domain — results
+        stay bit-identical to a solo run at ``g`` (the
+        ``yask_tpu.serve.buckets`` contract); infeasible solutions
+        (non-jit modes, IF_DOMAIN conditions) decline and open exact,
+        with the structured reason journaled on every batched row."""
+        from yask_tpu.serve.api import serve_bucketing_enabled
+        requested = serve_bucketing_enabled() if bucket is None \
+            else bool(bucket)
+        decision, sub, host_g = self._plan_bucket(
+            stencil, radius, g, mode, wf, options, requested)
+        prof = self.registry.get_profile(stencil, radius, host_g, mode,
                                          wf, options)
         if self._preflight:
             self._run_preflight(prof)
-        return self.registry.open_session(prof, session).sid
+        return self.registry.open_session(prof, session, sub_sizes=sub,
+                                          bucket=decision).sid
+
+    def _plan_bucket(self, stencil, radius, g, mode: str, wf: int,
+                     options: str, requested: bool):
+        """The open-time bucketing verdict: (BucketDecision,
+        sub_sizes-or-None, host geometry).  Feasibility is probed on
+        an UNPREPARED solution (equations + mode are all it needs), so
+        a declined session never pays a wasted bucket-rung prepare."""
+        from yask_tpu.serve.buckets import BucketDecision, plan_bucket
+        try:
+            gi = int(g)
+        except (TypeError, ValueError):
+            return (BucketDecision(
+                "exact", g=0,
+                reason=f"non-cubic geometry {g!r} serves exact"),
+                None, g)
+        if not requested:
+            return (BucketDecision("exact", g=gi,
+                                   reason="bucketing not requested"),
+                    None, g)
+        probe = self._factory.new_solution(self._env, stencil=stencil,
+                                           radius=radius)
+        probe.get_settings().mode = mode
+        decision = plan_bucket(probe, gi, True)
+        if decision.decision != "bucketed":
+            return decision, None, g
+        sub = None
+        if decision.bucket != gi:
+            sub = {d: gi for d in probe._opts.global_domain_sizes}
+        return decision, sub, decision.bucket
 
     def _run_preflight(self, prof) -> None:
         """Serve-pass checks over the profile, log-only (the bench
@@ -122,15 +167,24 @@ class StencilServer:
 
     def init_vars(self, sid: str) -> None:
         """The standard nonzero initial conditions
-        (``init_solution_vars``) for this session's state."""
+        (``init_solution_vars``) for this session's state — over the
+        tenant's SUB-domain when the session is bucket-hosted, so a
+        bucketed tenant starts bit-identical to its solo twin."""
         from yask_tpu.runtime.init_utils import init_solution_vars
+        sess = self.registry.session(sid)
         with self.scheduler.session_ctx(sid) as ctx:
-            init_solution_vars(ctx)
+            init_solution_vars(ctx, sub_sizes=sess.sub_sizes)
+
+    def session_bucket(self, sid: str) -> Dict:
+        """The session's structured bucketing verdict (empty for the
+        pre-bucketing open path)."""
+        b = self.registry.session(sid).bucket
+        return b.as_detail() if b is not None else {}
 
     # ----------------------------------------------------- requests
 
-    def submit(self, req: ServeRequest):
-        return self.scheduler.submit(req)
+    def submit(self, req: ServeRequest, on_stream=None):
+        return self.scheduler.submit(req, on_stream=on_stream)
 
     def wait(self, handle, timeout: Optional[float] = None
              ) -> ServeResponse:
@@ -142,22 +196,28 @@ class StencilServer:
 
     def run(self, sid: str, first_step: int,
             last_step: Optional[int] = None,
-            outputs=(), timeout: Optional[float] = None
+            outputs=(), timeout: Optional[float] = None,
+            flush_every: int = 0, stream_outputs: bool = False
             ) -> ServeResponse:
         return self.request(
             ServeRequest(session=sid, first_step=first_step,
                          last_step=last_step,
-                         outputs=tuple(outputs)), timeout)
+                         outputs=tuple(outputs),
+                         flush_every=int(flush_every),
+                         stream_outputs=bool(stream_outputs)), timeout)
 
     def submit_run(self, sid: str, first_step: int,
-                   last_step: Optional[int] = None, outputs=()):
+                   last_step: Optional[int] = None, outputs=(),
+                   flush_every: int = 0, stream_outputs: bool = False):
         """Non-blocking :meth:`run` — returns the pending handle for
         :meth:`wait`.  Submitting a whole sweep before waiting is what
         lands compatible requests inside one batching window."""
         return self.submit(
             ServeRequest(session=sid, first_step=first_step,
                          last_step=last_step,
-                         outputs=tuple(outputs)))
+                         outputs=tuple(outputs),
+                         flush_every=int(flush_every),
+                         stream_outputs=bool(stream_outputs)))
 
     # ----------------------------------------------------- warm start
 
@@ -213,6 +273,8 @@ class StencilServer:
             "anomalies": sum(1 for s in done
                              if s["status"] == "anomaly"),
             "degraded": sum(1 for s in done if s["degraded"]),
+            "bucketed": sum(1 for s in done if s.get("bucketed")),
+            "preempted": sum(1 for s in done if s.get("preempted")),
             "batch_occupancy_mean": (sum(occ) / len(occ)) if occ
             else 0.0,
             "batch_occupancy_max": max(occ) if occ else 0,
