@@ -87,11 +87,14 @@ perfcheck: lint
 # the resilience layer end-to-end on the CPU mesh: fault taxonomy /
 # guards / journal / checkpoint units plus the acceptance paths —
 # injected relay-drop resume, all-zero quarantine, SIGKILL-mid-run
-# kill-resume (same-mode and cross-mode restore), and the injected
-# device-hang pallas → jit degradation ladder (see docs/resilience.md)
+# kill-resume (same-mode and cross-mode restore), the injected
+# device-hang pallas → jit degradation ladder, and the fleet failover
+# chaos acceptance (chaos-killed worker → checkpoint-backed session
+# failover bit-identical to an uninterrupted twin, exactly-once
+# in-flight retry, heartbeat-miss replacement — see docs/resilience.md)
 faultcheck: lint
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m pytest \
-		tests/test_resilience.py -q
+		tests/test_resilience.py tests/test_fleet_failover.py -q
 
 # the communication scheduler end-to-end on the CPU mesh: plan
 # construction, coalescing/order bit-equality, corner composition,

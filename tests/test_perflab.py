@@ -203,6 +203,45 @@ def test_guard_and_append_ignores_bisect_history(tmp_path):
     assert row["guard"]["status"] == "no_history"
 
 
+def test_seed_rows_from_bench_and_fresh_clone_guarding(tmp_path,
+                                                       monkeypatch):
+    # PERF_LEDGER.jsonl no longer ships in git: a fresh clone seeds its
+    # baselines from the committed BENCH_*.json snapshots instead of
+    # judging every first measurement as no_history
+    from yask_tpu.perflab import ledger as ledger_mod
+    root = tmp_path / "root"
+    root.mkdir()
+    (root / "BENCH_r01.json").write_text(json.dumps(
+        {"platform": "cpu", "rows": [
+            {"metric": "iso seed", "value": 0.10, "unit": "GPts/s",
+             "provenance": {"ncpu": 1, "loadavg": [0.1, 0.1, 0.1]}},
+            {"metric": "other", "value": 1.0, "unit": "GPts/s"}]}))
+    (root / "BENCH_r02.json").write_text(json.dumps(
+        {"platform": "tpu", "rows": [
+            {"metric": "iso seed", "value": 9.9, "unit": "GPts/s"}]}))
+    (root / "BENCH_junk.json").write_text("{not json")
+    rows = ledger_mod.seed_rows_from_bench("iso seed", "cpu",
+                                           root=str(root))
+    assert len(rows) == 1        # metric-matched, cpu doc only
+    assert rows[0]["source"] == "bench_seed"
+    assert rows[0]["value"] == 0.10
+    assert rows[0]["provenance"]["cpu_model"] == ""   # backfilled
+    assert is_clean(rows[0])
+
+    monkeypatch.setattr(ledger_mod, "repo_root", lambda: str(root))
+    path = str(tmp_path / "ledger.jsonl")
+    row = guard_and_append("iso seed", 0.098, "GPts/s", "cpu", "test",
+                           _prov(), path=path)
+    assert row["guard"]["status"] == "ok"
+    assert row["guard"]["baseline"] == pytest.approx(0.10)
+    # ... and a first-measurement regression is CAUGHT, not waved
+    # through as no_history
+    row = guard_and_append("iso seed", 0.05, "GPts/s", "cpu", "test",
+                           _prov(), remeasure=lambda: 0.05,
+                           path=str(tmp_path / "ledger2.jsonl"))
+    assert row["guard"]["status"] == "regression"
+
+
 # ------------------------------------------------------------ provenance
 
 def test_provenance_on_stub_proc(tmp_path):

@@ -204,6 +204,52 @@ def test_run_deadlined_ok_and_kill():
     assert "partial" in (ei.value.partial_stdout or "")
 
 
+def test_guarded_call_backoff_jitter_bounds(monkeypatch):
+    # the sleep schedule is the fleet's anti-lockstep contract:
+    # delay = min(backoff * 2^attempt, max_backoff) * (1 + jitter*U)
+    # with U in [0, 1) — verify both the exact formula at a pinned U
+    # and the [base, base*(1+jitter)) envelope.
+    from yask_tpu.resilience import guard as guard_mod
+    backoff, max_backoff, jitter, retries = 0.5, 2.0, 0.25, 4
+    for u in (0.0, 0.5, 0.999):
+        monkeypatch.setenv("YT_FAULT_PLAN", "t.jit:relay_down:99")
+        reset_faults()
+        sleeps = []
+        monkeypatch.setattr(guard_mod.time, "sleep", sleeps.append)
+        monkeypatch.setattr(guard_mod.random, "random", lambda: u)
+        with pytest.raises(RelayDown):
+            guarded_call(lambda: "never", site="t.jit",
+                         retries=retries, backoff=backoff,
+                         max_backoff=max_backoff, jitter=jitter)
+        assert len(sleeps) == retries      # one sleep per retry
+        for attempt, got in enumerate(sleeps):
+            base = min(backoff * (2 ** attempt), max_backoff)
+            assert got == pytest.approx(base * (1.0 + jitter * u))
+            assert base <= got < base * (1.0 + jitter)
+        # exponential then capped: 0.5, 1.0, 2.0, 2.0 (scaled by jitter)
+        bases = [s / (1.0 + jitter * u) for s in sleeps]
+        assert bases == pytest.approx([0.5, 1.0, 2.0, 2.0])
+
+
+def test_run_deadlined_partial_stdout_drains_only_pre_kill():
+    # everything flushed before the SIGKILL survives in
+    # .partial_stdout; output the child never reached is absent — the
+    # drain is the real pipe contents, not a re-run.
+    with pytest.raises(DeviceHang) as ei:
+        run_deadlined(python_cmd(
+            "import time\n"
+            "print('line-one', flush=True)\n"
+            "print('line-two', flush=True)\n"
+            "time.sleep(60)\n"
+            "print('never-happens', flush=True)\n"), 1.0,
+            site="t.drain")
+    got = ei.value.partial_stdout or ""
+    assert "line-one" in got and "line-two" in got
+    assert "never-happens" not in got
+    assert ei.value.site == "t.drain"
+    assert ei.value.kind == "device_hang"
+
+
 # ---------------------------------------------------------------- journal
 
 def test_journal_roundtrip_and_resume(tmp_path):
@@ -788,3 +834,48 @@ def test_yk_stats_halo_cal_unstable_flag():
                    nfpops_pp=1, elapsed=1.0)
     assert st2.get_halo_cal_unstable() is False
     assert "halo-cal-unstable" not in st2.format()
+
+
+class _HaloCalCtx:
+    """Just the attributes _calibrate_halo_frac touches."""
+    def __init__(self):
+        self._halo_frac = {}
+        self._halo_cal_spread = {}
+        self._halo_cal_unstable = {}
+        self._halo_cal_reps = {}
+        self._halo_tcall = {}
+
+        class _Env:
+            def get_platform(self):
+                return "cpu"
+        self._env = _Env()
+
+
+def test_halo_cal_unstable_banks_none_not_noise(monkeypatch):
+    # Twice-unstable calibration must bank NO split (None → halo_time
+    # reports null), never a noise-derived fraction; a stable one
+    # keeps the measured fraction.
+    from yask_tpu.parallel import shard_step
+
+    def fake_unstable(sample, trials=3):
+        return (1.0, 9.9, True, 13)
+    monkeypatch.setattr(shard_step, "timed_median", fake_unstable)
+    ctx = _HaloCalCtx()
+    got = shard_step._calibrate_halo_frac(ctx, "k", None, None, {}, 0)
+    assert got is None
+    assert ctx._halo_frac["k"] is None          # key PRESENT: no re-cal
+    assert "k" in ctx._halo_frac
+    assert ctx._halo_cal_unstable["k"] is True
+    # the runtime call-site coercion: None reads as "no split"
+    assert (ctx._halo_frac["k"] or 0.0) == 0.0
+
+    # stable twin: the measured fraction banks as before
+    seq = iter([(1.0, 0.01, False, 3), (2.0, 0.01, False, 3)])
+
+    def fake_stable(sample, trials=3):
+        return next(seq)
+    monkeypatch.setattr(shard_step, "timed_median", fake_stable)
+    ctx2 = _HaloCalCtx()
+    got2 = shard_step._calibrate_halo_frac(ctx2, "k", None, None, {}, 0)
+    assert got2 == pytest.approx(0.5)           # 1 - t_no/t_ex
+    assert ctx2._halo_cal_unstable["k"] is False

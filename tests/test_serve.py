@@ -13,6 +13,7 @@ tenants alive on flaky hardware is tested without hardware.
 """
 
 import json
+import os
 import threading
 
 import numpy as np
@@ -372,6 +373,54 @@ def test_journal_compact_keeps_one_row_per_request(tmp_path):
     rows = j.rows()
     assert [r["rid"] for r in rows] == ["r1", "r2", "r3"]
     assert [r["event"] for r in rows] == ["ok", "rejected", "received"]
+
+
+def test_journal_compact_preserves_occupancy_evidence(tmp_path):
+    # the co-batching acceptance probe reads max_occupancy() from
+    # batched rows — compaction must keep the best one per rid even
+    # after the terminal row lands
+    j = ServeJournal(str(tmp_path / "J.jsonl"))
+    j.record("r1", "s", "received")
+    j.record("r1", "s", "batched", batch=2)
+    j.record("r1", "s", "batched", batch=5)    # the high-water mark
+    j.record("r1", "s", "batched", batch=3)
+    j.record("r1", "s", "ok")
+    j.record("r2", "s", "received")
+    j.record("r2", "s", "ok")
+    before = j.max_occupancy()
+    assert before == 5
+    j.compact()
+    rows = j.rows()
+    assert j.max_occupancy() == before         # evidence survived
+    assert [r["event"] for r in rows] == ["batched", "ok", "ok"]
+    assert rows[0]["detail"]["batch"] == 5
+    j.compact()                                # idempotent
+    assert j.max_occupancy() == before
+
+
+def test_journal_compact_if_large_threshold(tmp_path, monkeypatch):
+    from yask_tpu.serve.journal import serve_journal_max_bytes
+    p = str(tmp_path / "J.jsonl")
+    j = ServeJournal(p)
+    for i in range(50):
+        j.record("r1", "s", "received", pad="x" * 64)
+    j.record("r1", "s", "ok")
+    size = os.path.getsize(p)
+    assert not j.compact_if_large(max_bytes=size + 1)   # under: no-op
+    assert os.path.getsize(p) == size
+    assert j.compact_if_large(max_bytes=size - 1)       # over: compacts
+    assert os.path.getsize(p) < size
+    assert j.terminal("r1") == "ok"
+    # the env knob parses MB (bad values fall back to 64)
+    monkeypatch.setenv("YT_JOURNAL_MAX_MB", "2")
+    assert serve_journal_max_bytes() == 2 * (1 << 20)
+    monkeypatch.setenv("YT_JOURNAL_MAX_MB", "not-a-number")
+    assert serve_journal_max_bytes() == 64 * (1 << 20)
+    monkeypatch.delenv("YT_JOURNAL_MAX_MB")
+    assert serve_journal_max_bytes() == 64 * (1 << 20)
+    # missing file: False, never raises
+    assert not ServeJournal(str(tmp_path / "nope.jsonl")) \
+        .compact_if_large()
 
 
 def test_journal_never_raises_on_unwritable_path(tmp_path):

@@ -399,12 +399,16 @@ def run_suite(fac, env, budget_secs=None):
                     ranks=[("x", ndev)], measure_halo=True)
         rate = measure(ctx, ga ** 3, steps)
         st = ctx.get_stats()
-        halo_pct = (100.0 * st.get_halo_secs()
-                    / max(st.get_elapsed_secs(), 1e-12))
+        # twice-unstable calibration banks NO split: halo_pct is null
+        # (the row still carries total throughput + halo_cal_unstable)
+        halo_pct = None
+        if not st.get_halo_cal_unstable():
+            halo_pct = round(100.0 * st.get_halo_secs()
+                             / max(st.get_elapsed_secs(), 1e-12), 2)
         emit(f"awp {ga}^3 {plat} x{ndev} shard_map", rate, "GPts/s",
              remeasure=lambda: measure(ctx, ga ** 3, steps),
              roofline=ctx_roofline(ctx, env, rate),
-             halo_pct=round(halo_pct, 2), **_comm_of(ctx))
+             halo_pct=halo_pct, **_comm_of(ctx))
         del ctx
 
     def sm_coalesce():

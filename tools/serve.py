@@ -26,6 +26,12 @@ Ops::
         # submit-all-then-wait-all: the shape that actually exercises
         # the micro-batching window
     {"op": "metrics"} / {"op": "flush_metrics"} / {"op": "cache_stats"}
+    {"op": "ping"}                      # liveness heartbeat (fleet
+                                        # supervision; cheap, no device
+                                        # work)
+    {"op": "snapshot", "sid": ...}      # interior-coordinate checkpoint
+        -> {"ok": true, "meta": {...}, "state": {var: [slot...]}}
+    {"op": "restore", "sid": ..., "meta": {...}, "state": {...}}
     {"op": "close", "sid": ...}
     {"op": "shutdown"}
 
@@ -76,6 +82,22 @@ def _decode_array(d: dict):
     return np.asarray(d["data"],
                       dtype=np.dtype(d.get("dtype", "float32"))
                       ).reshape(d.get("shape", [-1]))
+
+
+def _worker_chaos() -> None:
+    """YT_FAULT_PLAN chaos hooks for the fleet supervision tests.  An
+    injected ``worker_dead`` at site ``fleet.kill_worker`` hard-exits
+    the worker process (SIGKILL semantics: no cleanup, no reply on the
+    pipe — exactly what a crashed worker looks like to the front); a
+    ``hang`` at ``fleet.hang_worker`` stalls it past the front's
+    liveness deadline.  Probed at op entry, at every chunk-boundary
+    stream flush (so a kill can land MID-run), and on ``ping``."""
+    from yask_tpu.resilience.faults import WorkerDead, fault_point
+    try:
+        fault_point("fleet.kill_worker")
+    except WorkerDead:
+        os._exit(17)
+    fault_point("fleet.hang_worker")
 
 
 def _encode_stream_event(ev: dict) -> dict:
@@ -183,6 +205,7 @@ class ServeFront:
         line.  Defensive — a dropped client must cost the beacon, not
         the run (the scheduler's flush policy, extended to the wire)."""
         def push(ev):
+            _worker_chaos()  # a chaos kill lands at a chunk boundary
             line = {"stream": True, "sid": sid,
                     **_encode_stream_event(ev)}
             if rid is not None:
@@ -194,6 +217,7 @@ class ServeFront:
         return push
 
     def op_run(self, msg, emit=None):
+        _worker_chaos()
         req = self._req(msg)
         hook = None
         if emit is not None and req.flush_every > 0:
@@ -217,6 +241,27 @@ class ServeFront:
                  for h in handles]
         return {"ok": True,
                 "responses": [_encode_response(r) for r in resps]}
+
+    def op_ping(self, msg):
+        _worker_chaos()
+        return {"ok": True, "pid": os.getpid(),
+                "sessions": len(self.server.registry.sessions())}
+
+    def op_snapshot(self, msg):
+        snap = self.server.snapshot(msg["sid"])
+        return {"ok": True, "meta": snap["meta"],
+                "state": {k: [_encode_array(a) for a in ring]
+                          for k, ring in snap["state"].items()}}
+
+    def op_restore(self, msg):
+        snap = {"meta": msg["meta"],
+                "state": {k: [_decode_array(d) for d in ring]
+                          for k, ring in msg["state"].items()}}
+        ok = self.server.restore(msg["sid"], snap)
+        out = {"ok": bool(ok)}
+        if not ok:
+            out["error"] = "snapshot did not apply (identity mismatch)"
+        return out
 
     def op_metrics(self, msg):
         return {"ok": True, "metrics": self.server.metrics()}

@@ -72,16 +72,21 @@ class ServeClient:
     @classmethod
     def spawn(cls, extra_args: Sequence[str] = (),
               env: Optional[Dict[str, str]] = None,
-              stderr=None) -> "ServeClient":
+              stderr=None, start_new_session: bool = False
+              ) -> "ServeClient":
         """Launch ``tools/serve.py`` as a stdio child.  The child
         inherits this interpreter and environment (callers set
         ``JAX_PLATFORMS``/``PALLAS_AXON_POOL_IPS`` as the situation
-        demands — the examples force the CPU path)."""
+        demands — the examples force the CPU path).  The fleet
+        supervisor passes ``start_new_session=True`` so an unhealthy
+        worker can be taken down whole with ``os.killpg`` — the
+        ``run_deadlined`` SIGKILL semantics, applied to workers."""
         e = dict(os.environ if env is None else env)
         proc = subprocess.Popen(
             [sys.executable, SERVE_PY, *extra_args],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            stderr=stderr, env=e, text=True)
+            stderr=stderr, env=e, text=True,
+            start_new_session=bool(start_new_session))
         return cls(proc.stdout, proc.stdin, proc=proc)
 
     @classmethod
@@ -191,6 +196,10 @@ class ServeClient:
                 ev["outputs"] = {k: decode_array(v)
                                  for k, v in ev["outputs"].items()}
         return out
+
+    def ping(self) -> Dict:
+        """Liveness heartbeat (fleet supervision)."""
+        return self.call("ping")
 
     def metrics(self) -> Dict:
         return self.call("metrics")["metrics"]

@@ -33,10 +33,38 @@ Topology::
   ``{"stream": true}`` lines are re-emitted to the fleet's client as
   they arrive (per-worker pipes are serialized by a lock, so a
   stream line can only belong to the in-flight call on that worker).
+* **Supervision** (fleet failover): workers are spawned in their own
+  process group and health-checked — a dead process, a missed
+  ``ping`` heartbeat past the liveness deadline
+  (``YT_FLEET_HB_DEADLINE``, consecutive-miss threshold
+  ``YT_FLEET_HB_MISSES``), or an EOF mid-op all declare the worker
+  dead.  The front SIGKILLs the whole group (``run_deadlined``
+  semantics), spawns a replacement that warm-starts from the shared
+  compile cache, and FAILS THE SESSIONS OVER: each routed session is
+  re-opened on the replacement (``session=sid``), restored from the
+  last banked checkpoint (the ``snapshot``/``restore`` worker ops —
+  r14 interior-coordinate snapshots, banked at a
+  ``YT_FLEET_CKPT_EVERY``-step cadence on op boundaries), and the
+  state-mutating ops since that committed boundary are replayed in
+  order.  The recovered state is bit-identical to an uninterrupted
+  twin (the r14 kill-resume contract at fleet scope).  An op in
+  flight on the dead worker is re-issued EXACTLY ONCE under its
+  idempotency key (``idem``, front-stamped on every forwarded op):
+  the retry happens only when no response was delivered, against
+  state rolled back to the last committed boundary, so its effects
+  apply once.  Already-emitted ``{"stream": true}`` lines may repeat
+  on a retried streaming run (streams are at-least-once; the final
+  response is exactly-once).  Every migration is journaled
+  (``SERVE_JOURNAL.fleet.jsonl``: ``worker_dead`` → ``failover`` with
+  the dead worker id, snapshot step and replayed step ranges →
+  ``retry``).
 
 The fleet front performs no device work itself — every op is a
 forwarded worker call over pipes; the guarded device sites live in the
-workers' serve package.
+workers' serve package.  Chaos injection: ``fleet.route`` (front),
+``fleet.heartbeat`` (front, a dropped heartbeat), and the worker-side
+``fleet.kill_worker`` / ``fleet.hang_worker`` sites in
+``tools/serve.py``.
 
 Usage::
 
@@ -68,16 +96,58 @@ def fleet_max_queue() -> int:
         return 64
 
 
+def _env_num(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def fleet_ckpt_every() -> int:
+    """Checkpoint cadence in steps (``YT_FLEET_CKPT_EVERY``, default
+    8): after a session accumulates this many run steps past its last
+    banked snapshot, the front banks a fresh one at the next op
+    boundary."""
+    return max(1, int(_env_num("YT_FLEET_CKPT_EVERY", 8)))
+
+
+def fleet_hb_deadline() -> float:
+    """Heartbeat liveness deadline in seconds (``YT_FLEET_HB_DEADLINE``,
+    default 10): a ``ping`` that has not answered by then is a miss."""
+    return max(0.1, _env_num("YT_FLEET_HB_DEADLINE", 10.0))
+
+
+def fleet_hb_misses() -> int:
+    """Consecutive heartbeat misses before a worker is declared
+    unhealthy and replaced (``YT_FLEET_HB_MISSES``, default 2)."""
+    return max(1, int(_env_num("YT_FLEET_HB_MISSES", 2)))
+
+
 class FleetWorker:
     """One spawned serve.py child + its pipe lock and journal path."""
 
     def __init__(self, idx: int, client: ServeClient,
-                 journal_path: str):
+                 journal_path: str, gen: int = 0):
         self.idx = idx
+        self.gen = gen  # bumped on every replacement spawn
         self.client = client
         self.journal_path = journal_path
         self.lock = threading.Lock()  # serializes this worker's pipe
         self.sessions: set = set()
+        self.hb_misses = 0
+
+    def alive(self) -> bool:
+        """Process liveness (with a short grace for the EOF→exit
+        race).  Socket-transport clients are assumed alive — only the
+        spawned-worker topology is supervised."""
+        p = self.client._proc
+        if p is None:
+            return True
+        try:
+            p.wait(timeout=1.0)
+            return False
+        except subprocess.TimeoutExpired:
+            return True
 
     def call(self, op: str, on_stream=None, **fields) -> Dict:
         with self.lock:
@@ -113,25 +183,52 @@ class ServeFleet:
                  cache_dir: Optional[str] = None,
                  journal_dir: Optional[str] = None,
                  worker_args: List[str] = (),
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 hb_secs: Optional[float] = None):
+        from yask_tpu.serve.journal import ServeJournal
         self.closing = threading.Event()
         self._route_table: Dict[str, FleetWorker] = {}
         self._lock = threading.RLock()
         self._next_sid = 0
-        jdir = journal_dir or os.getcwd()
+        self._next_idem = 0
+        #: per-sid failover bank: stored open fields, the last banked
+        #: checkpoint (raw wire form — passed back to ``restore``
+        #: verbatim), and the state-mutating ops since that boundary.
+        self._bank: Dict[str, Dict] = {}
+        self._jdir = journal_dir or os.getcwd()
         base_env = dict(os.environ if env is None else env)
         if cache_dir:
             base_env["YT_COMPILE_CACHE"] = cache_dir
         self.cache_dir = base_env.get("YT_COMPILE_CACHE", "")
+        self._base_env = base_env
+        self._worker_args = list(worker_args)
+        #: the front's own lifecycle journal (worker_dead / snapshot /
+        #: failover / retry — the auditable migration trail).
+        self.journal = ServeJournal(os.path.join(
+            self._jdir, "SERVE_JOURNAL.fleet.jsonl"))
         self.workers: List[FleetWorker] = []
         for i in range(max(1, int(n_workers))):
-            jpath = os.path.join(jdir, f"SERVE_JOURNAL.w{i}.jsonl")
-            wenv = dict(base_env)
-            wenv["YT_SERVE_JOURNAL"] = jpath
-            client = ServeClient.spawn(
-                extra_args=list(worker_args),
-                env=wenv, stderr=subprocess.DEVNULL)
-            self.workers.append(FleetWorker(i, client, jpath))
+            self.workers.append(self._spawn_worker(i))
+        self._hb_secs = _env_num("YT_FLEET_HB_SECS", 0.0) \
+            if hb_secs is None else float(hb_secs)
+        self._hb_thread = None
+        if self._hb_secs > 0:
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, daemon=True)
+            self._hb_thread.start()
+
+    def _spawn_worker(self, idx: int, gen: int = 0) -> FleetWorker:
+        """Spawn worker ``idx`` (its own process group so an unhealthy
+        one dies whole via killpg; replacements reuse the journal path
+        and warm-start from the shared compile cache)."""
+        jpath = os.path.join(self._jdir, f"SERVE_JOURNAL.w{idx}.jsonl")
+        wenv = dict(self._base_env)
+        wenv["YT_SERVE_JOURNAL"] = jpath
+        client = ServeClient.spawn(
+            extra_args=list(self._worker_args),
+            env=wenv, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+        return FleetWorker(idx, client, jpath, gen=gen)
 
     # --------------------------------------------------------- routing
 
@@ -163,6 +260,238 @@ class ServeFleet:
                                 t[1]["sessions"], t[0].idx))
         return occ[0][0]
 
+    # --------------------------------------------------- supervision
+
+    def _hb_loop(self) -> None:
+        while not self.closing.wait(self._hb_secs):
+            try:
+                self.supervise_tick()
+            except Exception:  # noqa: BLE001 - supervision must not
+                pass           # take the front down
+
+    def supervise_tick(self) -> None:
+        """One synchronous health pass over the fleet (the background
+        loop calls this every ``hb_secs``; tests call it directly).
+        A dead process fails over immediately; an idle worker gets a
+        ``ping`` under the liveness deadline — ``YT_FLEET_HB_MISSES``
+        consecutive misses declare it unhealthy.  Busy workers are
+        skipped: the in-flight call path detects death by EOF."""
+        for w in list(self.workers):
+            with self._lock:
+                if self.workers[w.idx] is not w:
+                    continue  # replaced since we listed
+            if not w.alive():
+                self._failover(w, cause="worker process exited")
+                continue
+            if not w.lock.acquire(blocking=False):
+                continue
+            try:
+                ok = self._ping_deadlined(w)
+            finally:
+                w.lock.release()
+            if ok:
+                w.hb_misses = 0
+                continue
+            w.hb_misses += 1
+            if w.hb_misses >= fleet_hb_misses():
+                self._failover(
+                    w, cause=f"missed {w.hb_misses} heartbeats "
+                             f"(deadline {fleet_hb_deadline()}s)")
+
+    def _ping_deadlined(self, w: FleetWorker) -> bool:
+        """One heartbeat under the liveness deadline.  Caller holds
+        ``w.lock``.  ``fleet.heartbeat`` is the front-side chaos site:
+        an injected fault here IS a dropped heartbeat.  The ping runs
+        on a helper thread because a hung worker never answers — a
+        blocked pipe read must cost the deadline, not the supervisor
+        (``run_deadlined``'s contract without the subprocess)."""
+        from yask_tpu.resilience.faults import Fault, fault_point
+        try:
+            fault_point("fleet.heartbeat")
+        except Fault:
+            return False
+        result: Dict = {}
+
+        def do_ping():
+            try:
+                result["out"] = w.client.call("ping")
+            except Exception as e:  # noqa: BLE001
+                result["err"] = e
+
+        t = threading.Thread(target=do_ping, daemon=True)
+        t.start()
+        t.join(fleet_hb_deadline())
+        return (not t.is_alive()) and "out" in result
+
+    def _failover(self, w: FleetWorker, cause="") -> FleetWorker:
+        """Replace a dead/unhealthy worker and fail its sessions over.
+        Idempotent per worker object: concurrent detectors (heartbeat
+        loop, in-flight EOF) race to the fleet lock and the losers see
+        the replacement already installed."""
+        with self._lock:
+            if self.workers[w.idx] is not w:
+                return self.workers[w.idx]
+            self.journal.record(
+                f"w{w.idx}.g{w.gen}", "-", "worker_dead",
+                worker=w.idx, gen=w.gen, cause=str(cause)[:200],
+                sessions=sorted(w.sessions))
+            self._kill_worker(w)
+            repl = self._spawn_worker(w.idx, gen=w.gen + 1)
+            self.workers[w.idx] = repl
+            self._recover_sessions(w, repl)
+            return repl
+
+    @staticmethod
+    def _kill_worker(w: FleetWorker) -> None:
+        """SIGKILL the worker's whole process group (it was spawned
+        with ``start_new_session=True``) and drop the pipes — the
+        ``run_deadlined`` semantics applied to a worker."""
+        import signal
+        p = w.client._proc
+        if p is not None:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                try:
+                    p.kill()
+                except (OSError, ProcessLookupError):
+                    pass
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+        for f in (w.client._w, w.client._r):
+            try:
+                f.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _recover_sessions(self, dead: FleetWorker,
+                          repl: FleetWorker) -> None:
+        """Re-open every session routed to the dead worker on the
+        replacement, restore the banked checkpoint, and replay the
+        state-mutating ops past that committed boundary (deterministic
+        — the r14 contract makes the result bit-identical to an
+        uninterrupted run).  Caller holds the fleet lock."""
+        for sid in sorted(dead.sessions):
+            b = self._bank.get(sid)
+            try:
+                if b is None:
+                    raise ServeClientError("no banked open fields")
+                repl.call("open", **b["open"])
+                snap_step = None
+                if b["snapshot"] is not None:
+                    out = repl.call("restore", sid=sid,
+                                    meta=b["snapshot"]["meta"],
+                                    state=b["snapshot"]["state"])
+                    if not out.get("ok"):
+                        raise ServeClientError(
+                            "banked snapshot did not apply")
+                    snap_step = int(
+                        b["snapshot"]["meta"].get("cur_step", 0))
+                replayed = []
+                for m in b["log"]:
+                    repl.call(m["op"], **{k: v for k, v in m.items()
+                                          if k not in ("op", "id")})
+                    if m["op"] == "run":
+                        replayed.append(
+                            [int(m.get("first", 0)),
+                             m.get("last")])
+                self._route_table[sid] = repl
+                repl.sessions.add(sid)
+                self.journal.record(
+                    sid, sid, "failover", dead_worker=dead.idx,
+                    dead_gen=dead.gen, to_worker=repl.idx,
+                    to_gen=repl.gen, snapshot_step=snap_step,
+                    replayed=replayed)
+            except Exception as e:  # noqa: BLE001 - an unrecoverable
+                # session must not block the rest of the fleet
+                self._route_table.pop(sid, None)
+                self.journal.record(
+                    sid, sid, "failover", dead_worker=dead.idx,
+                    dead_gen=dead.gen, recovered=False,
+                    error=f"{type(e).__name__}: {e}")
+
+    # -------------------------------------------------- checkpointing
+
+    def _stamp_idem(self, msg: dict) -> str:
+        """Front-generated idempotency key, stamped onto every
+        forwarded op (workers ignore unknown fields).  A retry after
+        failover carries the SAME key, and the journal ``retry`` row
+        records it — the exactly-once audit trail."""
+        with self._lock:
+            idem = msg.get("idem") or f"i{self._next_idem:06d}"
+            self._next_idem += 1
+        msg["idem"] = idem
+        return idem
+
+    @staticmethod
+    def _mutates(op: str) -> bool:
+        return op in ("fill", "init", "run", "restore")
+
+    def _note_ok(self, sid: str, msg: dict) -> None:
+        """Bookkeeping after a successful forwarded op: log state
+        mutations for replay; bank a fresh checkpoint once the
+        session has run ``YT_FLEET_CKPT_EVERY`` steps past the last
+        committed boundary."""
+        op = msg.get("op", "")
+        if not self._mutates(op):
+            return
+        with self._lock:
+            b = self._bank.get(sid)
+            if b is None:
+                return
+            b["log"].append(dict(msg))
+            if op == "run":
+                first = int(msg.get("first", 0))
+                last = msg.get("last")
+                b["steps"] += (1 if last is None
+                               else max(1, int(last) - first + 1))
+                due = b["steps"] >= fleet_ckpt_every()
+            else:
+                due = False
+        if due:
+            self._bank_snapshot(sid)
+
+    def _bank_snapshot(self, sid: str) -> bool:
+        """Pull a checkpoint from the owning worker and bank it as the
+        session's committed boundary (clears the replay log).  Banked
+        in raw wire form — ``restore`` gets it back verbatim, so the
+        front never decodes arrays.  A failed snapshot just keeps the
+        longer replay log: correctness does not depend on cadence."""
+        try:
+            w = self._route(sid)
+            out = w.call("snapshot", sid=sid)
+        except Exception:  # noqa: BLE001
+            return False
+        if not out.get("ok"):
+            return False
+        with self._lock:
+            b = self._bank.get(sid)
+            if b is None:
+                return False
+            b["snapshot"] = {"meta": out["meta"],
+                             "state": out["state"]}
+            b["log"] = []
+            b["steps"] = 0
+        self.journal.record(
+            sid, sid, "snapshot",
+            step=int(out["meta"].get("cur_step", 0)), worker=w.idx)
+        return True
+
+    def _maybe_snapshot_before_run(self, sid: str) -> None:
+        """Pre-run commit point: bank a checkpoint when none exists
+        yet or when un-snapshotted fills/inits are in the log (state
+        writes are cheaper to bank once than to hold for replay
+        forever)."""
+        with self._lock:
+            b = self._bank.get(sid)
+            need = b is not None and (
+                b["snapshot"] is None
+                or any(m.get("op") != "run" for m in b["log"]))
+        if need:
+            self._bank_snapshot(sid)
+
     # ------------------------------------------------------------- ops
 
     def handle(self, msg: dict, emit=None) -> dict:
@@ -183,8 +512,37 @@ class ServeFleet:
         return out
 
     def _forward(self, msg: dict, emit=None) -> dict:
-        w = self._route(msg["sid"])
-        return self._worker_call(w, msg, emit)
+        sid = msg["sid"]
+        if msg.get("op") == "run":
+            self._maybe_snapshot_before_run(sid)
+        out = self._call_with_failover(msg, emit, sids=(sid,))
+        if out.get("ok"):
+            self._note_ok(sid, msg)
+        return out
+
+    def _call_with_failover(self, msg: dict, emit=None,
+                            sids=()) -> dict:
+        """Forward to the owning worker; when the worker DIED mid-op
+        (EOF/broken pipe + process gone), fail over and re-issue the
+        op exactly once under its idempotency key.  Application errors
+        from a live worker re-raise untouched — only a lost answer is
+        retryable."""
+        idem = self._stamp_idem(msg)
+        w = self._route(msg["sid"] if "sid" in msg else sids[0])
+        try:
+            return self._worker_call(w, msg, emit)
+        except (ServeClientError, OSError) as e:
+            with self._lock:
+                replaced = self.workers[w.idx] is not w
+            if not replaced and w.alive():
+                raise  # the worker answered; not a death
+            self._failover(w, cause=e)
+            sid0 = msg.get("sid") or (sids[0] if sids else "")
+            w2 = self._route(sid0)  # raises when not recovered
+            self.journal.record(idem, sid0, "retry", idem=idem,
+                                op=msg.get("op", ""), worker=w2.idx,
+                                gen=w2.gen)
+            return self._worker_call(w2, msg, emit)
 
     @staticmethod
     def _worker_call(w: FleetWorker, msg: dict, emit=None) -> dict:
@@ -216,10 +574,22 @@ class ServeFleet:
                         "error": f"fleet session {sid!r} already open"}
         fields = {k: v for k, v in msg.items() if k not in ("op", "id")}
         fields["session"] = sid
-        out = w.call("open", **fields)
+        try:
+            out = w.call("open", **fields)
+        except (ServeClientError, OSError) as e:
+            with self._lock:
+                replaced = self.workers[w.idx] is not w
+            if not replaced and w.alive():
+                raise
+            self._failover(w, cause=e)
+            w = self._admit()  # re-place on a live worker, once
+            out = w.call("open", **fields)
         with self._lock:
             self._route_table[out["sid"]] = w
             w.sessions.add(out["sid"])
+            self._bank[out["sid"]] = {"open": dict(fields),
+                                      "snapshot": None,
+                                      "log": [], "steps": 0}
         out["worker"] = w.idx
         return out
 
@@ -228,6 +598,7 @@ class ServeFleet:
         out = w.call("close", sid=msg["sid"])
         with self._lock:
             self._route_table.pop(msg["sid"], None)
+            self._bank.pop(msg["sid"], None)
             w.sessions.discard(msg["sid"])
         return out
 
@@ -244,7 +615,9 @@ class ServeFleet:
         errs: List[str] = []
 
         def run_shard(widx: int, idxs: List[int]) -> None:
-            w = self.workers[widx]
+            shard_sids = [reqs[i]["sid"] for i in idxs]
+            for sid in dict.fromkeys(shard_sids):
+                self._maybe_snapshot_before_run(sid)
             sub = {"op": "run_many",
                    "requests": [reqs[i] for i in idxs]}
             if "timeout" in msg:
@@ -252,9 +625,13 @@ class ServeFleet:
             if "id" in msg:
                 sub["id"] = msg["id"]
             try:
-                out = self._worker_call(w, sub, emit)
+                out = self._call_with_failover(sub, emit,
+                                               sids=shard_sids)
                 for i, r in zip(idxs, out["responses"]):
                     results[i] = r
+                for i in idxs:
+                    self._note_ok(reqs[i]["sid"],
+                                  {"op": "run", **reqs[i]})
             except Exception as e:  # noqa: BLE001
                 errs.append(f"worker {widx}: {type(e).__name__}: {e}")
 
@@ -326,6 +703,9 @@ class ServeFleet:
     # ------------------------------------------------------- lifecycle
 
     def close(self) -> None:
+        self.closing.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
         for w in self.workers:
             try:
                 with w.lock:
@@ -374,6 +754,10 @@ def main(argv=None) -> int:
     ap.add_argument("--window_ms", type=float, default=None)
     ap.add_argument("--max_batch", type=int, default=None)
     ap.add_argument("--no-preflight", action="store_true")
+    ap.add_argument("--hb_secs", type=float, default=5.0,
+                    help="heartbeat supervision interval; 0 disables "
+                         "the background health loop "
+                         "(YT_FLEET_HB_SECS overrides when unset)")
     args = ap.parse_args(argv)
 
     wargs: List[str] = []
@@ -387,7 +771,8 @@ def main(argv=None) -> int:
     fleet = ServeFleet(n_workers=args.workers,
                        cache_dir=args.cache_dir,
                        journal_dir=args.journal_dir,
-                       worker_args=wargs)
+                       worker_args=wargs,
+                       hb_secs=args.hb_secs)
     try:
         if args.port is not None:
             import socket

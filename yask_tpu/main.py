@@ -198,6 +198,11 @@ def run_harness(argv: Optional[List[str]] = None, out=None) -> int:
                 out.write(
                     f"  halo-fraction (%): "
                     f"{100.0 * st.get_halo_secs() / max(dt, 1e-12):.4g}\n")
+            elif st.get_halo_cal_unstable():
+                # twice-unstable twin: no split is banked — total step
+                # time is the evidence, the halo share is unknown
+                out.write("  halo-time (sec): null "
+                          "(calibration unstable)\n")
     finally:
         if profiling:
             env.stop_profiler_trace()
@@ -256,8 +261,9 @@ def run_harness(argv: Optional[List[str]] = None, out=None) -> int:
                       if st.get_halo_cal_spread() > 0 else {}),
                    # calibration kept an outlier beyond 3× the agreeing
                    # pair's spread even after the one re-time: the split
-                   # is noise — marked, not banked as evidence
-                   **({"halo_cal_unstable": True}
+                   # is noise — halo_time reports null (no noise-derived
+                   # split banked), total step time stands alone
+                   **({"halo_cal_unstable": True, "halo_time": None}
                       if st.get_halo_cal_unstable() else {}),
                    # how many trials the calibration burned (6 = clean;
                    # more = outlier re-times / the final scaled round)

@@ -600,9 +600,18 @@ def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
 
     t_no, sp_no, un_no, rp_no = timed_median(lambda: timed(fn_no))
     t_ex, sp_ex, un_ex, rp_ex = timed_median(lambda: timed(fn))
-    ctx._halo_frac[key] = max(0.0, 1.0 - t_no / t_ex) if t_ex > 0 else 0.0
+    unstable = bool(un_no or un_ex)
+    if unstable:
+        # Twice-unstable twin: the (real − twin) subtraction is noise,
+        # not a halo datum.  Bank NO split (halo_time reports null and
+        # the halo timer stays untouched) instead of a noise-derived
+        # fraction — total step time is still real evidence.
+        ctx._halo_frac[key] = None
+    else:
+        ctx._halo_frac[key] = max(0.0, 1.0 - t_no / t_ex) \
+            if t_ex > 0 else 0.0
     ctx._halo_cal_spread[key] = max(sp_no, sp_ex)
-    ctx._halo_cal_unstable[key] = bool(un_no or un_ex)
+    ctx._halo_cal_unstable[key] = unstable
     ctx._halo_cal_reps[key] = rp_no + rp_ex
     ctx._halo_tcall[key] = t_ex
     if fn_xonly is not None:
@@ -936,7 +945,7 @@ def run_shard_map(ctx, start: int, n: int) -> None:
             _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
                                  fn_xonly=fn_x, fn_pack=fn_p)
             del fn_no, fn_x, fn_p
-        frac = ctx._halo_frac[key]
+        frac = ctx._halo_frac[key] or 0.0  # None = unstable, no split
         ctx._halo_xround_last = ctx._halo_xround.get(key, 0.0)
         ctx._halo_xpack_last = ctx._halo_xpack.get(key, 0.0)
         ctx._halo_cal_spread_last = ctx._halo_cal_spread.get(key, 0.0)
@@ -1468,7 +1477,7 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
                                  fn_xonly=fn_x, fn_pack=fn_p)
             del fn_no, fn_x, fn_p
             t0r += time.perf_counter() - t0cal
-        frac = ctx._halo_frac[key]
+        frac = ctx._halo_frac[key] or 0.0  # None = unstable, no split
         ctx._halo_xround_last = ctx._halo_xround.get(key, 0.0)
         ctx._halo_xpack_last = ctx._halo_xpack.get(key, 0.0)
         ctx._halo_cal_spread_last = ctx._halo_cal_spread.get(key, 0.0)
@@ -1486,7 +1495,8 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
             t_x = ctx._halo_xround.get(key, 0.0)
             t_call = ctx._halo_tcall.get(key, 0.0)
             eff = 0.0
-            if rounds > 0 and t_x > 0 and t_call > 0:
+            if rounds > 0 and t_x > 0 and t_call > 0 \
+                    and ctx._halo_frac.get(key) is not None:
                 eff = max(0.0, min(1.0, 1.0 - (frac * t_call)
                                    / (rounds * t_x)))
             ctx._halo_overlap_eff[key] = eff

@@ -41,7 +41,7 @@ LEDGER_BASENAME = "PERF_LEDGER.jsonl"
 #: who measured the row; new producers register here so query tooling
 #: can enumerate them.
 KNOWN_SOURCES = ("bench", "suite", "harness", "tpu_session", "multichip",
-                 "bisect", "perfcheck", "test")
+                 "bisect", "perfcheck", "test", "bench_seed")
 
 _REQUIRED = ("v", "key", "value", "unit", "platform", "source",
              "measured_at", "provenance")
@@ -182,6 +182,52 @@ def read_rows(path: Optional[str] = None, key: Optional[str] = None,
                 rows.append(row)
     except OSError:
         pass
+    return rows
+
+
+def seed_rows_from_bench(key: str, platform: str,
+                         root: Optional[str] = None) -> List[Dict]:
+    """Baseline rows for ``key`` recovered from the committed
+    ``BENCH_*.json`` artifacts at the repo root (rows keyed
+    ``metric``, converted via :func:`from_legacy`), oldest file first.
+
+    ``PERF_LEDGER.jsonl`` is a runtime artifact and no longer ships in
+    git, so a fresh clone has no ledger history — the sentinel seeds
+    its trailing median from these committed bench snapshots instead
+    of judging every first measurement as ``no_history``."""
+    import glob
+    root = root or repo_root()
+    rows: List[Dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        plat = doc.get("platform", "")
+        if platform and plat and plat != platform:
+            continue
+        for rec in doc.get("rows", []):
+            if not isinstance(rec, dict) \
+                    or rec.get("metric") != key:
+                continue
+            rec = dict(rec)
+            prov = dict(rec.pop("provenance", None) or {})
+            prov.setdefault("loadavg", [])
+            prov.setdefault("cpu_model", "")
+            prov.setdefault("git_sha", "")
+            guard = rec.pop("guard", None)
+            try:
+                row = from_legacy(rec, "bench_seed", prov)
+            except ValueError:
+                continue
+            if guard:
+                # the snapshot's own verdict rides along so is_clean
+                # keeps a recorded regression out of the baseline
+                row["guard"] = guard
+            rows.append(row)
     return rows
 
 

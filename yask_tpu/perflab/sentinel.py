@@ -341,6 +341,10 @@ def guard_and_append(key: str, value: float, unit: str, platform: str,
     history = [r for r in
                _ledger.read_rows(path=path, key=key, platform=platform)
                if r.get("source") != "bisect"]
+    if not any(is_clean(r) for r in history):
+        # fresh clone / untracked ledger: seed the baseline from the
+        # committed BENCH_*.json snapshots (older than any live row)
+        history = _ledger.seed_rows_from_bench(key, platform) + history
     guard = check_row(key, value, unit, platform, history, rules=rules,
                       remeasure=remeasure)
     row = _ledger.make_row(key, value, unit, platform, source,

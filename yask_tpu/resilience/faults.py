@@ -41,9 +41,9 @@ from typing import Dict, List, Optional
 
 __all__ = [
     "Fault", "RelayDown", "DeviceHang", "CompilerOOM", "CompileFailed",
-    "ResultAnomaly", "FAULT_KINDS", "classify", "classify_message",
-    "Breaker", "default_breaker_path", "fault_point", "maybe_corrupt",
-    "reset_faults", "active_plan",
+    "ResultAnomaly", "WorkerDead", "WorkerUnhealthy", "FAULT_KINDS",
+    "classify", "classify_message", "Breaker", "default_breaker_path",
+    "fault_point", "maybe_corrupt", "reset_faults", "active_plan",
 ]
 
 
@@ -95,9 +95,23 @@ class ResultAnomaly(Fault):
     kind = "result_anomaly"
 
 
+class WorkerDead(Fault):
+    """A serve-fleet worker process exited (crash, OOM-kill, injected
+    chaos kill).  Not retryable against the dead worker; the fleet
+    supervisor fails the routed sessions over to a replacement."""
+    kind = "worker_dead"
+
+
+class WorkerUnhealthy(Fault):
+    """A serve-fleet worker missed its heartbeat/liveness deadline
+    (hung pipe, wedged backend) without exiting.  The supervisor
+    SIGKILLs the process group and treats it as :class:`WorkerDead`."""
+    kind = "worker_unhealthy"
+
+
 FAULT_KINDS = {cls.kind: cls for cls in
                (RelayDown, DeviceHang, CompilerOOM, CompileFailed,
-                ResultAnomaly)}
+                ResultAnomaly, WorkerDead, WorkerUnhealthy)}
 
 # Message signatures, most specific first.  A Mosaic OOM message also
 # matches the INTERNAL/compile signs, so the OOM test must win (the
@@ -329,6 +343,11 @@ def fault_point(site: str) -> None:
     if kind == "result_anomaly":
         raise ResultAnomaly(f"injected result anomaly at {site}",
                             site=site)
+    if kind == "worker_dead":
+        raise WorkerDead(f"injected worker death at {site}", site=site)
+    if kind == "worker_unhealthy":
+        raise WorkerUnhealthy(f"injected unhealthy worker at {site}",
+                              site=site)
 
 
 def maybe_corrupt(site: str, value):

@@ -6,7 +6,8 @@ Schema ``yask_tpu.serve/1`` — one row per request-lifecycle event::
      "rid":     "r000007",             # request id
      "session": "tenant-3",
      "event":   "received|batched|ok|anomaly|rejected|fault|degraded"
-                "|stream|preempted",
+                "|stream|preempted|worker_dead|failover|retry"
+                "|snapshot",
      "ts":      "2026-08-05T12:00:00Z",
      "detail":  {...}}                 # event-specific (batch size,
                                        # fault kind, ladder rung, ...)
@@ -40,7 +41,14 @@ SERVE_JOURNAL_BASENAME = "SERVE_JOURNAL.jsonl"
 SERVE_TERMINAL = ("ok", "anomaly", "rejected")
 
 SERVE_EVENTS = ("received", "batched", "ok", "anomaly", "rejected",
-                "fault", "degraded", "stream", "preempted")
+                "fault", "degraded", "stream", "preempted",
+                # fleet supervision lifecycle (front-side journal):
+                # worker_dead = a worker was declared dead/unhealthy,
+                # failover = a session migrated (detail: dead worker
+                # id, snapshot step, replayed step range), retry = an
+                # in-flight op re-issued under its idempotency key,
+                # snapshot = a checkpoint banked for a session.
+                "worker_dead", "failover", "retry", "snapshot")
 
 
 def _repo_root() -> str:
@@ -51,6 +59,16 @@ def _repo_root() -> str:
 def default_serve_journal_path() -> str:
     return os.environ.get("YT_SERVE_JOURNAL") or os.path.join(
         _repo_root(), SERVE_JOURNAL_BASENAME)
+
+
+def serve_journal_max_bytes() -> int:
+    """Size threshold for :meth:`ServeJournal.compact_if_large`
+    (``YT_JOURNAL_MAX_MB``, default 64 MiB)."""
+    try:
+        mb = float(os.environ.get("YT_JOURNAL_MAX_MB", "") or 64.0)
+    except ValueError:
+        mb = 64.0
+    return int(mb * (1 << 20))
 
 
 def _utc_now() -> str:
@@ -127,21 +145,56 @@ class ServeJournal:
     # ----------------------------------------------------------- admin
     def compact(self, keep_terminal_only: bool = True) -> int:
         """Atomically rewrite to the last event per rid (terminal rows
-        preferred); run between servers, never during one."""
+        preferred); run between servers, never during one.
+
+        Admission control and the co-batching acceptance probe read
+        ``max_occupancy()`` from ``batched`` rows, so compaction keeps
+        the highest-occupancy ``batched`` row per rid alongside the
+        terminal row — the occupancy evidence survives any number of
+        compactions."""
         rows = self.rows()
         last: Dict[str, Dict] = {}
+        best_batched: Dict[str, Dict] = {}
         order: List[str] = []
         for r in rows:
             rid = r.get("rid", "")
             if rid not in last:
                 order.append(rid)
+            if r["event"] == "batched":
+                prev = best_batched.get(rid)
+                occ = int(r.get("detail", {}).get("batch", 0))
+                if prev is None or occ > int(prev.get("detail", {})
+                                             .get("batch", 0)):
+                    best_batched[rid] = r
             if not keep_terminal_only or r["event"] in SERVE_TERMINAL \
                     or last.get(rid, {}).get("event") \
                     not in SERVE_TERMINAL:
                 last[rid] = r
+        kept = 0
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             for rid in order:
+                bb = best_batched.get(rid)
+                if bb is not None and bb is not last[rid]:
+                    f.write(json.dumps(bb, sort_keys=True) + "\n")
+                    kept += 1
                 f.write(json.dumps(last[rid], sort_keys=True) + "\n")
+                kept += 1
         os.replace(tmp, self.path)
-        return len(rows) - len(order)
+        return len(rows) - kept
+
+    def compact_if_large(self, max_bytes: Optional[int] = None) -> bool:
+        """Compact when the journal file exceeds ``max_bytes``
+        (default :func:`serve_journal_max_bytes`).  Long-lived fleet
+        workers call this at startup and between requests so
+        ``SERVE_JOURNAL.w<i>.jsonl`` cannot grow unbounded.  Never
+        raises — growth control must not take a worker down."""
+        try:
+            limit = serve_journal_max_bytes() if max_bytes is None \
+                else int(max_bytes)
+            if os.path.getsize(self.path) <= limit:
+                return False
+            self.compact()
+            return True
+        except (OSError, ValueError):
+            return False

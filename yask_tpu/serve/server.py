@@ -60,6 +60,11 @@ class StencilServer:
         self._factory = factory or yk_factory()
         self._env = env if env is not None else self._factory.new_env()
         self.journal = ServeJournal(journal_path)
+        # journal growth control: a long-lived fleet worker restarts
+        # onto the same SERVE_JOURNAL.w<i>.jsonl — compact it past the
+        # YT_JOURNAL_MAX_MB threshold before appending more (between
+        # servers is the safe compaction window).
+        self.journal.compact_if_large()
         self.registry = SessionRegistry(self._factory, self._env)
         self.scheduler = BatchScheduler(self.registry, self.journal,
                                         window_secs=window_secs,
@@ -218,6 +223,26 @@ class StencilServer:
                          outputs=tuple(outputs),
                          flush_every=int(flush_every),
                          stream_outputs=bool(stream_outputs)))
+
+    # ------------------------------------------------- checkpointing
+
+    def snapshot(self, sid: str) -> Dict:
+        """An interior-coordinate checkpoint of the session's state
+        (``yask_tpu.checkpoint/1``), taken under the session's device
+        lock so it never races a running chunk.  Restores
+        bit-identically across modes/paddings — the fleet front banks
+        these for checkpoint-backed failover."""
+        from yask_tpu.resilience.checkpoint import extract_snapshot
+        with self.scheduler.session_ctx(sid) as ctx:
+            return extract_snapshot(ctx)
+
+    def restore(self, sid: str, snap: Dict) -> bool:
+        """Apply a banked checkpoint onto the session (ring state +
+        step counters).  Returns False on a schema/shape mismatch
+        (``apply_snapshot`` contract: never raises)."""
+        from yask_tpu.resilience.checkpoint import apply_snapshot
+        with self.scheduler.session_ctx(sid) as ctx:
+            return bool(apply_snapshot(ctx, snap))
 
     # ----------------------------------------------------- warm start
 
