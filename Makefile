@@ -7,7 +7,8 @@ TEST_ENV ?= PALLAS_AXON_POOL_IPS=
 
 .PHONY: all native capi test test-fast scratch-tests boundary-tests \
         stages-tests mode-tests bench perfcheck faultcheck commcheck \
-        cachecheck servecheck examples clean list-stencils lint check
+        cachecheck servecheck obscheck examples clean list-stencils \
+        lint check
 
 all: native test
 
@@ -71,10 +72,19 @@ servecheck: lint
 		tests/test_serve.py tests/test_serve_buckets.py \
 		tests/test_fleet.py -q
 
+# the observability spine: tracer no-op guarantee (YT_TRACE unset =>
+# bit-identical run, no file), span nesting/attrs, metrics percentile
+# parity with the old server quantiles, end-to-end trace_id joins
+# across journal/ledger/trace artifacts, Perfetto export validity,
+# trace compaction bounds (see docs/observability.md)
+obscheck: lint
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_obs.py -q
+
 # static checker over the flagship configs: Mosaic legality, VMEM
 # feasibility (incl. the round-3 spill-OOM class), races, explain.
 # See docs/checking.md; nonzero exit on any error-severity finding.
-check: cachecheck servecheck
+check: cachecheck servecheck obscheck
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker \
 		-stencil iso3dfd -radius 8 -g 256 -mode pallas -wf_steps 2
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker -all_stencils

@@ -105,9 +105,11 @@ def _record_tpu_result(line: dict) -> None:
     persistent log — the source for ``last_tpu_measured`` when a later
     capture falls back to CPU. Never fatal."""
     try:
+        from yask_tpu.obs.tracer import stamp_trace
         rec = dict(line)
         rec["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime())
+        stamp_trace(rec)
         with open(_tpu_results_path(), "a") as f:
             f.write(json.dumps(rec) + "\n")
     except Exception:

@@ -301,6 +301,63 @@ def test_compile_direct_exempt_in_cache_and_pragma(tmp_path):
     assert fs == []
 
 
+def test_trace_id_fires_on_unstamped_jsonl_append(tmp_path):
+    fs = lint_src(tmp_path, """\
+        import json
+
+        def bank(path, row):
+            with open(path, "a") as f:
+                f.write(json.dumps(row) + "\\n")
+    """)
+    assert fired(fs) == ["TRACE-ID"]
+    assert fs[0]["line"] == 4
+
+
+def test_trace_id_satisfied_by_stamp_or_explicit_field(tmp_path):
+    fs = lint_src(tmp_path, """\
+        import json
+        from yask_tpu.obs.tracer import stamp_trace
+
+        def bank(path, row):
+            stamp_trace(row)
+            with open(path, "a") as f:
+                f.write(json.dumps(row) + "\\n")
+
+        def bank2(path, row, trace_id=""):
+            if trace_id:
+                row["trace_id"] = trace_id
+            with open(path, "a") as f:
+                f.write(json.dumps(row) + "\\n")
+    """)
+    assert fs == []
+
+
+def test_trace_id_ignores_non_jsonl_appends(tmp_path):
+    # a plain text log appender (no json.dumps) is not a journal
+    fs = lint_src(tmp_path, """\
+        def log(path, line):
+            with open(path, "a") as f:
+                f.write(line + "\\n")
+    """)
+    assert fs == []
+
+
+def test_trace_id_pragma_and_tests_scope(tmp_path):
+    src = """\
+        import json
+
+        def bank(path, row):
+            with open(path, "a") as f:  # lint: trace-id-ok
+                f.write(json.dumps(row) + "\\n")
+    """
+    assert lint_src(tmp_path, src) == []
+    bare = src.replace("  # lint: trace-id-ok", "")
+    assert fired(lint_src(tmp_path, bare)) == ["TRACE-ID"]
+    # tests/ fixture writers are out of scope
+    assert lint_tool(tmp_path, bare,
+                     name=os.path.join("tests", "t.py")) == []
+
+
 def test_repo_is_clean():
     findings = repo_lint.run_lint([ROOT], root=ROOT)
     assert findings == [], findings

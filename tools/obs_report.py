@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Render TRACE_EVENTS.jsonl: per-phase breakdown + Perfetto export.
+
+Reads the span rows the obs tracer appends (schema ``yask_tpu.trace/1``,
+see ``yask_tpu/obs/tracer.py``) and answers the two questions a trace
+exists for:
+
+* **Where did the time go?**  The terminal report buckets spans by
+  phase using SELF-TIME attribution — each span's duration minus the
+  durations of its direct children in the same trace — so nested spans
+  (``guard:run.chunk`` inside ``serve.chunk`` inside
+  ``run.supervised``) are not double-counted, and queue-wait and
+  exchange show up as their own lines instead of hiding inside
+  compute.  Retroactive ``halo.share`` spans (the measured exchange
+  fraction of a fused program call — the exchange runs INSIDE the
+  jitted scan, so it cannot be a nested child) are additionally moved
+  out of the compute bucket.  Halo-calibration instability
+  (``halo_cal`` spans with ``unstable: true``) is surfaced in the
+  table — an unstable split means the exchange line is noise, not a
+  datum.
+* **What did it look like?**  ``--perfetto OUT`` writes Chrome
+  trace-event JSON (``{"traceEvents": [...]}``, ``ph: "X"`` complete
+  events, µs timestamps): load it in ui.perfetto.dev or
+  chrome://tracing.  One lane per (pid, tid) — the fleet front, each
+  worker process, and the scheduler's device thread land on separate
+  rows, aligned on wall-clock ``ts``.
+
+Usage::
+
+    python tools/obs_report.py                      # latest trace
+    python tools/obs_report.py --trace t4f2ab...    # one trace
+    python tools/obs_report.py --trace all          # everything
+    python tools/obs_report.py --perfetto out.json  # + Perfetto dump
+    python -m yask_tpu.tools.log_to_csv --traces    # flat CSV instead
+
+No device work, no jax import — safe to run anywhere, any time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from yask_tpu.obs.tracer import PHASES, default_trace_path, read_spans
+
+
+def pick_trace(rows: List[Dict], trace: str = "") -> List[Dict]:
+    """Filter rows to one trace id; default = the LATEST trace (the one
+    whose newest span has the greatest wall ts); ``"all"`` keeps every
+    row."""
+    if trace == "all":
+        return list(rows)
+    if not trace:
+        latest: Dict[str, float] = {}
+        for r in rows:
+            t = r.get("trace", "")
+            latest[t] = max(latest.get(t, 0.0), float(r.get("ts", 0.0)))
+        if not latest:
+            return []
+        trace = max(latest, key=lambda t: latest[t])
+    return [r for r in rows if r.get("trace") == trace]
+
+
+def self_times(rows: List[Dict]) -> Dict[str, float]:
+    """span id → duration minus direct children's durations (floored
+    at 0 — children on other threads can overlap their parent)."""
+    child_dur: Dict[str, float] = {}
+    for r in rows:
+        p = r.get("parent", "")
+        if p:
+            child_dur[p] = child_dur.get(p, 0.0) + float(r.get("dur", 0.0))
+    return {r["span"]: max(0.0, float(r.get("dur", 0.0))
+                           - child_dur.get(r.get("span", ""), 0.0))
+            for r in rows if "span" in r}
+
+
+def phase_breakdown(rows: List[Dict]) -> Dict[str, Dict]:
+    """Per-phase ``{secs, count}`` from self-times, with ``halo.share``
+    exchange evidence moved out of the compute bucket (it measures a
+    slice of a compute span's interval, not a nested child)."""
+    selfs = self_times(rows)
+    out: Dict[str, Dict] = {}
+    halo_share = 0.0
+    for r in rows:
+        ph = r.get("phase") or "other"
+        b = out.setdefault(ph, {"secs": 0.0, "count": 0})
+        b["secs"] += selfs.get(r.get("span", ""), 0.0)
+        b["count"] += 1
+        if r.get("name") == "halo.share":
+            halo_share += float(r.get("dur", 0.0))
+    if halo_share > 0 and "compute" in out:
+        out["compute"]["secs"] = max(
+            0.0, out["compute"]["secs"] - halo_share)
+        out["compute"]["halo_share_moved"] = halo_share
+    return out
+
+
+def halo_cal_status(rows: List[Dict]) -> Dict:
+    """Aggregate the halo-calibration spans: rep/spread evidence plus
+    whether any calibration came out UNSTABLE (ledger parity — an
+    unstable split is noise, not a halo datum)."""
+    cals = [r for r in rows if r.get("name") == "halo_cal"]
+    att = [r.get("attrs", {}) for r in cals]
+    return {
+        "count": len(cals),
+        "reps": sum(int(a.get("reps", 0) or 0) for a in att),
+        "max_spread": max([float(a.get("spread", 0.0) or 0.0)
+                           for a in att] or [0.0]),
+        "unstable": sum(1 for a in att if a.get("unstable")),
+    }
+
+
+def report(rows: List[Dict], top: int = 10, out=None) -> None:
+    out = out or sys.stdout
+    if not rows:
+        out.write("no spans\n")
+        return
+    traces = sorted({r.get("trace", "") for r in rows})
+    pids = sorted({r.get("pid", 0) for r in rows})
+    t0 = min(float(r.get("ts", 0.0)) for r in rows)
+    t1 = max(float(r.get("ts", 0.0)) + float(r.get("dur", 0.0))
+             for r in rows)
+    out.write(f"trace: {', '.join(traces)}\n")
+    out.write(f"spans: {len(rows)}  processes: {len(pids)}  "
+              f"wall: {t1 - t0:.4f} s\n\n")
+
+    bk = phase_breakdown(rows)
+    total = sum(b["secs"] for b in bk.values()) or 1.0
+    order = [p for p in PHASES if p in bk] \
+        + sorted(set(bk) - set(PHASES))
+    out.write(f"{'phase':<12} {'self-time':>10} {'%':>6} {'spans':>6}\n")
+    for ph in order:
+        b = bk[ph]
+        out.write(f"{ph:<12} {b['secs']:>9.4f}s "
+                  f"{100.0 * b['secs'] / total:>5.1f}% "
+                  f"{b['count']:>6}\n")
+    moved = bk.get("compute", {}).get("halo_share_moved", 0.0)
+    if moved:
+        out.write(f"  (exchange evidence: {moved:.4f}s halo.share "
+                  "moved out of compute)\n")
+    hc = halo_cal_status(rows)
+    if hc["count"]:
+        flag = (f"UNSTABLE x{hc['unstable']}" if hc["unstable"]
+                else "stable")
+        out.write(f"halo-cal: {flag}  reps={hc['reps']} "
+                  f"max_spread={hc['max_spread']:.3f}\n")
+
+    out.write(f"\ntop {min(top, len(rows))} spans by duration:\n")
+    for r in sorted(rows, key=lambda r: -float(r.get("dur", 0.0)))[:top]:
+        attrs = json.dumps(r.get("attrs", {}), sort_keys=True)
+        if len(attrs) > 60:
+            attrs = attrs[:57] + "..."
+        out.write(f"  {float(r.get('dur', 0.0)):>9.4f}s "
+                  f"{(r.get('phase') or '-'):<10} "
+                  f"{r.get('name', '?'):<24} {attrs}\n")
+
+
+def to_perfetto(rows: List[Dict]) -> Dict:
+    """Chrome trace-event JSON: ``ph: "X"`` complete events in µs on
+    the wall clock, one lane per (pid, tid), phase as the category,
+    span/trace ids + attrs in ``args``."""
+    events: List[Dict] = []
+    for pid in sorted({r.get("pid", 0) for r in rows}):
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0,
+                       "args": {"name": f"yask_tpu pid {pid}"}})
+    for r in rows:
+        events.append({
+            "ph": "X",
+            "name": r.get("name", "?"),
+            "cat": r.get("phase") or "other",
+            "ts": float(r.get("ts", 0.0)) * 1e6,
+            "dur": float(r.get("dur", 0.0)) * 1e6,
+            "pid": r.get("pid", 0),
+            "tid": r.get("tid", 0),
+            "args": {"trace": r.get("trace", ""),
+                     "span": r.get("span", ""),
+                     "parent": r.get("parent", ""),
+                     **r.get("attrs", {})},
+        })
+    return {"traceEvents": events,
+            "displayTimeUnit": "ms",
+            "metadata": {"schema": "yask_tpu.trace/1"}}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-phase breakdown + Perfetto export of the "
+                    "obs span trace")
+    ap.add_argument("--path", default=None,
+                    help="trace file (default: YT_TRACE_EVENTS or "
+                         "repo-root TRACE_EVENTS.jsonl)")
+    ap.add_argument("--trace", default="",
+                    help="trace id to report ('all' = every trace; "
+                         "default: the latest)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slowest-span list length")
+    ap.add_argument("--perfetto", default=None, metavar="OUT",
+                    help="also write Chrome/Perfetto trace-event JSON")
+    args = ap.parse_args(argv)
+
+    rows = pick_trace(read_spans(args.path or default_trace_path()),
+                      args.trace)
+    report(rows, top=args.top)
+    if args.perfetto:
+        with open(args.perfetto, "w") as f:
+            json.dump(to_perfetto(rows), f, sort_keys=True)
+        sys.stdout.write(f"\nperfetto: {args.perfetto} "
+                         f"({len(rows)} events)\n")
+    return 0 if rows else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,13 +50,21 @@ Rules (see docs/checking.md for the catalog):
   (``ckpt.save`` / ``ckpt.restore``) only classify when the call runs
   under ``guarded_call``; new run-loops that write checkpoints must
   route them through a guard.
+* ``TRACE-ID`` — a JSONL append site (a function with an append-mode
+  ``open`` plus a ``json.dumps``) that never references
+  ``stamp_trace`` / ``trace_id``.  Every journal/ledger-style row
+  must be joinable against TRACE_EVENTS.jsonl when a trace is active
+  (``yask_tpu/obs/tracer.py``); a new appender that forgets the stamp
+  silently drops its artifact out of the end-to-end correlation
+  spine.  Out of scope in ``tests/`` (fixture writers); the tracer's
+  own row writer is pragma'd — it IS the trace.
 
 Detection of "an Expr value" is lexical (this is a linter, not a type
 checker): names ``expr``/``lhs``/``rhs``/``eq``, the ``*_expr``
 suffix, and attribute access ``.lhs`` / ``.rhs``.  Escape hatch: put
 ``# lint: <rule>-ok`` on the flagged line (rule tokens: ``expr-eq``,
 ``expr-key``, ``devices``, ``mesh``, ``compile-direct``,
-``bare-device-call``, ``ckpt-unguarded``).
+``bare-device-call``, ``ckpt-unguarded``, ``trace-id``).
 
 Usage: ``python tools/repo_lint.py [paths...]`` — defaults to the
 repo root; exit 1 when anything fires.
@@ -382,6 +390,89 @@ def _lint_device_calls(tree: ast.AST, relpath: str,
     return findings
 
 
+# ---- TRACE-ID ------------------------------------------------------------
+_TRACE_REFS = {"stamp_trace", "trace_id"}
+
+
+def _trace_rule_in_scope(relpath: str) -> bool:
+    """Everything but tests/ — test fixtures legitimately write raw
+    JSONL; production journal/ledger appenders must stamp."""
+    return not relpath.startswith("tests" + os.sep)
+
+
+def _is_append_open(node: ast.Call) -> bool:
+    if not (isinstance(node.func, ast.Name) and node.func.id == "open"):
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    return (isinstance(mode, ast.Constant)
+            and isinstance(mode.value, str)
+            and mode.value.startswith("a"))
+
+
+def _shallow_nodes(scope: ast.AST):
+    """The nodes of one function (or module) body WITHOUT descending
+    into nested function scopes — each scope answers for its own
+    append sites."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _lint_trace_id(tree: ast.AST, relpath: str,
+                   lines: List[str]) -> List[dict]:
+    """Flag JSONL append sites (append-mode ``open`` + ``json.dumps``
+    in one scope) with no ``stamp_trace`` / ``trace_id`` reference."""
+    findings = []
+    scopes = [tree] + [n for n in ast.walk(tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+    for scope in scopes:
+        opens: List[ast.Call] = []
+        has_dumps = False
+        has_ref = False
+        for n in _shallow_nodes(scope):
+            if isinstance(n, ast.Call):
+                if _is_append_open(n):
+                    opens.append(n)
+                elif _call_name(n) == "dumps":
+                    has_dumps = True
+                has_ref = has_ref or any(
+                    kw.arg in _TRACE_REFS for kw in n.keywords)
+            elif isinstance(n, ast.Name) and n.id in _TRACE_REFS:
+                has_ref = True
+            elif isinstance(n, ast.Attribute) and n.attr in _TRACE_REFS:
+                has_ref = True
+            elif isinstance(n, ast.Constant) and n.value == "trace_id":
+                has_ref = True
+            elif isinstance(n, ast.alias) and n.name in _TRACE_REFS:
+                has_ref = True
+        if not opens or not has_dumps or has_ref:
+            continue
+        for node in opens:
+            line = (lines[node.lineno - 1]
+                    if node.lineno - 1 < len(lines) else "")
+            if "# lint: trace-id-ok" in line:
+                continue
+            findings.append({
+                "rule": "TRACE-ID", "path": relpath,
+                "line": node.lineno,
+                "message": "JSONL append site without a stamp_trace/"
+                           "trace_id reference — rows written here "
+                           "cannot join TRACE_EVENTS.jsonl; call "
+                           "yask_tpu.obs.tracer.stamp_trace(row) (or "
+                           "pragma a deliberately untraced artifact)"})
+    return findings
+
+
 def lint_file(path: str, root: str) -> List[dict]:
     relpath = os.path.relpath(path, root)
     with open(path, encoding="utf-8") as f:
@@ -397,6 +488,8 @@ def lint_file(path: str, root: str) -> List[dict]:
     findings = linter.findings
     if _device_rule_in_scope(relpath):
         findings.extend(_lint_device_calls(tree, relpath, lines))
+    if _trace_rule_in_scope(relpath):
+        findings.extend(_lint_trace_id(tree, relpath, lines))
     return findings
 
 

@@ -128,6 +128,8 @@ def _encode_response(resp) -> dict:
         out["preempted"] = int(resp.preempted)
     if resp.streams:
         out["streams"] = [_encode_stream_event(e) for e in resp.streams]
+    if resp.trace:
+        out["trace"] = resp.trace
     return out
 
 
@@ -146,9 +148,14 @@ class ServeFront:
         fn = getattr(self, f"op_{op}", None)
         if fn is None:
             return {"ok": False, "error": f"unknown op {op!r}"}
+        from yask_tpu.obs.tracer import activate
         try:
-            out = fn(msg, emit) if op in self._STREAMING_OPS \
-                else fn(msg)
+            # a front-stamped trace id rides the wire msg; activating it
+            # here makes every journal row / span this op produces join
+            # the SAME end-to-end trace ("" = no-op passthrough)
+            with activate(msg.get("trace", "")):
+                out = fn(msg, emit) if op in self._STREAMING_OPS \
+                    else fn(msg)
         except Exception as e:  # noqa: BLE001 - the front must answer
             out = {"ok": False,
                    "error": f"{type(e).__name__}: {e}"}
@@ -188,6 +195,7 @@ class ServeFront:
         return {"ok": True, "chunks": int(n)}
 
     def _req(self, m):
+        from yask_tpu.obs.tracer import current_trace_id
         from yask_tpu.serve import ServeRequest
         return ServeRequest(session=m["sid"],
                             first_step=int(m["first"]),
@@ -197,7 +205,9 @@ class ServeFront:
                             deadline_secs=float(m.get("deadline", 0.0)),
                             flush_every=int(m.get("flush_every", 0)),
                             stream_outputs=bool(
-                                m.get("stream_outputs", False)))
+                                m.get("stream_outputs", False)),
+                            trace=m.get("trace")
+                            or current_trace_id())
 
     @staticmethod
     def _stream_hook(emit, sid, rid):

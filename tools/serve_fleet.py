@@ -426,6 +426,21 @@ class ServeFleet:
         return idem
 
     @staticmethod
+    def _stamp_trace(msg: dict) -> str:
+        """Front-stamped end-to-end trace id (same shape as
+        ``_stamp_idem``): one id per client op, riding the forwarded
+        wire msg so the worker's journal/ledger rows and a failover
+        replay (the banked msg is re-issued verbatim, gen+1 included)
+        all join the SAME trace.  No-op unless ``YT_TRACE`` is on —
+        the msg is untouched and "" comes back."""
+        from yask_tpu.obs.tracer import new_trace_id, trace_enabled
+        tid = str(msg.get("trace", "") or "")
+        if not tid and trace_enabled():
+            tid = new_trace_id()
+            msg["trace"] = tid
+        return tid
+
+    @staticmethod
     def _mutates(op: str) -> bool:
         return op in ("fill", "init", "run", "restore")
 
@@ -497,18 +512,25 @@ class ServeFleet:
     def handle(self, msg: dict, emit=None) -> dict:
         op = msg.get("op")
         fn = getattr(self, f"op_{op}", None)
+        from yask_tpu.obs.tracer import activate, span
+        tid = self._stamp_trace(msg)
         try:
-            if fn is not None:
-                out = fn(msg, emit)
-            elif "sid" in msg:
-                # any other session-scoped op: pure affinity forward
-                out = self._forward(msg, emit)
-            else:
-                out = {"ok": False, "error": f"unknown op {op!r}"}
+            with activate(tid), \
+                    span(f"fleet.{op}", phase="front", trace=tid,
+                         sid=msg.get("sid", "")):
+                if fn is not None:
+                    out = fn(msg, emit)
+                elif "sid" in msg:
+                    # any other session-scoped op: pure affinity forward
+                    out = self._forward(msg, emit)
+                else:
+                    out = {"ok": False, "error": f"unknown op {op!r}"}
         except Exception as e:  # noqa: BLE001 - the front must answer
             out = {"ok": False, "error": f"{type(e).__name__}: {e}"}
         if "id" in msg:
             out["id"] = msg["id"]
+        if tid and "trace" not in out:
+            out["trace"] = tid
         return out
 
     def _forward(self, msg: dict, emit=None) -> dict:
@@ -620,6 +642,8 @@ class ServeFleet:
                 self._maybe_snapshot_before_run(sid)
             sub = {"op": "run_many",
                    "requests": [reqs[i] for i in idxs]}
+            if msg.get("trace"):
+                sub["trace"] = msg["trace"]
             if "timeout" in msg:
                 sub["timeout"] = msg["timeout"]
             if "id" in msg:

@@ -38,11 +38,17 @@ measured row passes the result-sanity guards (an all-zero field is banked as a q
 row, never a clean number — the round-3 quick-matrix incident).
 
 Run: ``python tools/tpu_session.py [-g 512] [--quick] [--resume |
---fresh] [--stages smoke,validate,...]``
+--fresh] [--stages smoke,validate,...] [-no-trace]``
 (needs the real backend: do NOT set JAX_PLATFORMS=cpu).
 ``YT_SESSION_MATRIX="name:radius,..."`` ("-" = default radius)
 overrides the validation matrix; ``YT_SESSION_JOURNAL`` relocates the
 journal; ``YT_SESSION_BANK=1`` banks rows off-TPU (tests).
+
+Tracing is ON by default here (``-trace``/``-no-trace``; an explicit
+``YT_TRACE`` env wins): hardware windows are the scarce resource, and a
+span timeline that joins the session journal / ledger rows is exactly
+the evidence a post-mortem of a dropped relay window needs.  See
+docs/observability.md.
 """
 
 from __future__ import annotations
@@ -235,6 +241,7 @@ def main(argv=None) -> int:
     g_bench = 512
     quick = False
     resume = False
+    trace = True
     stages = list(STAGES)
     journal_path = None
     i = 0
@@ -245,6 +252,10 @@ def main(argv=None) -> int:
             quick = True; i += 1
         elif argv[i] == "--resume":
             resume = True; i += 1
+        elif argv[i] in ("-trace", "--trace"):
+            trace = True; i += 1
+        elif argv[i] in ("-no-trace", "--no-trace"):
+            trace = False; i += 1
         elif argv[i] == "--fresh":
             resume = False
             try:
@@ -259,6 +270,12 @@ def main(argv=None) -> int:
         else:
             print(__doc__)
             return 2
+
+    # span tracing defaults ON for hardware sessions (an explicit
+    # YT_TRACE env wins either way; -no-trace opts out): the trace is
+    # the post-mortem record of a scarce relay window
+    if trace:
+        os.environ.setdefault("YT_TRACE", "1")
 
     from yask_tpu import yk_factory
     fac = yk_factory()

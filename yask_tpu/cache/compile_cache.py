@@ -278,6 +278,25 @@ def aot_compile(fn, example_args, *, key=None, platform: str = "",
                 donate_argnums=None, static_argnums=None) -> AotResult:
     """Build (or fetch) the executable for ``fn`` at the shapes of
     ``example_args`` — the one sanctioned ``jit→lower→compile`` site.
+    Every call opens a ``cache.aot`` span (phase ``compile``) whose
+    attrs record the hit tier — the trace answers "did this request
+    pay a lowering" without grepping stats."""
+    from yask_tpu.obs.tracer import span
+    with span("cache.aot", phase="compile",
+              keyed=key is not None) as sp:
+        res = _aot_compile(fn, example_args, key=key,
+                           platform=platform,
+                           donate_argnums=donate_argnums,
+                           static_argnums=static_argnums)
+        sp.set(hit=res.cache_hit or "miss",
+               compile_secs=round(res.compile_secs, 6),
+               digest=res.digest or "")
+        return res
+
+
+def _aot_compile(fn, example_args, *, key=None, platform: str = "",
+                 donate_argnums=None, static_argnums=None) -> AotResult:
+    """The uninstrumented chokepoint (see :func:`aot_compile`).
 
     ``key=None``: no persistence — a plain AOT compile that still
     feeds the trace counter (per-call shapes like the shard twins,

@@ -1,0 +1,15 @@
+"""Observability spine: span tracer + metrics registry + exporter
+hooks.  See ``docs/observability.md``; terminal/Perfetto rendering
+lives in ``tools/obs_report.py``."""
+
+from yask_tpu.obs.tracer import (  # noqa: F401
+    PHASES, TRACE_BASENAME, TRACE_SCHEMA, activate, compact_if_large,
+    current_span_id, current_trace_id, default_trace_path,
+    new_trace_id, phase_for_site, profile_window, read_spans,
+    record_span, set_trace, span, stamp_trace, trace_enabled,
+    trace_max_bytes,
+)
+from yask_tpu.obs.metrics import (  # noqa: F401
+    REGISTRY, Counter, Gauge, Histogram, Registry, get_registry,
+    percentile,
+)

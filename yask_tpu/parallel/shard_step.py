@@ -526,16 +526,39 @@ def timed_median(sample, trials=3):
     often settles the median) before the calibration is marked
     unstable (``halo_cal_unstable`` on the ledger row) instead of
     banking a noisy split as evidence.  The rep count is recorded so
-    the ledger row says how hard the number was to obtain."""
-    samples = sorted(sample() for _ in range(trials))
+    the ledger row says how hard the number was to obtain.
+
+    Every rep is recorded as a ``halo_cal.rep`` span (phase
+    ``exchange``) and each round's verdict as a ``halo_cal.round``
+    span carrying the spread/outlier attrs — a noisy split is visible
+    in the obs_report timeline, not only in ledger rows."""
+    from yask_tpu.obs.tracer import span
+
+    def one(rnd, i):
+        with span("halo_cal.rep", phase="exchange", round=rnd,
+                  rep=i) as sp:
+            v = sample()
+            sp.set(secs=v)
+        return v
+
+    def rnd(idx, n):
+        with span("halo_cal.round", phase="exchange", round=idx,
+                  trials=n) as sp:
+            s = sorted(one(idx, i) for i in range(n))
+            med = s[len(s) // 2]
+            sp.set(median=med, outlier=_is_outlier(s),
+                   spread=((s[-1] - s[0]) / med) if med > 0 else 0.0)
+        return s
+
+    samples = rnd(0, trials)
     reps = trials
     unstable = False
     if _is_outlier(samples):
-        samples = sorted(sample() for _ in range(trials))
+        samples = rnd(1, trials)
         reps += trials
         if _is_outlier(samples):
             n = 2 * trials + 1
-            samples = sorted(sample() for _ in range(n))
+            samples = rnd(2, n)
             reps += n
             unstable = _is_outlier(samples)
     med = samples[len(samples) // 2]
@@ -598,9 +621,15 @@ def _calibrate_halo_frac(ctx, key, fn, fn_no, interior, start,
                           + int((min_secs - el) / max(per, 1e-9)) + 1)
         return (time.perf_counter() - t0) / calls
 
-    t_no, sp_no, un_no, rp_no = timed_median(lambda: timed(fn_no))
-    t_ex, sp_ex, un_ex, rp_ex = timed_median(lambda: timed(fn))
-    unstable = bool(un_no or un_ex)
+    from yask_tpu.obs.tracer import span
+    with span("halo_cal", phase="exchange", key=repr(key)) as _cal_sp:
+        t_no, sp_no, un_no, rp_no = timed_median(lambda: timed(fn_no))
+        t_ex, sp_ex, un_ex, rp_ex = timed_median(lambda: timed(fn))
+        unstable = bool(un_no or un_ex)
+        _cal_sp.set(unstable=unstable,
+                    spread=max(sp_no, sp_ex), reps=rp_no + rp_ex,
+                    frac=(max(0.0, 1.0 - t_no / t_ex)
+                          if not unstable and t_ex > 0 else None))
     if unstable:
         # Twice-unstable twin: the (real − twin) subtraction is noise,
         # not a halo datum.  Bank NO split (halo_time reports null and
@@ -956,6 +985,7 @@ def run_shard_map(ctx, start: int, n: int) -> None:
         cal_secs = time.perf_counter() - t0cal
 
     t0c2 = time.perf_counter()
+    t0c2_wall = time.time()
     ctx._resident = None   # interior buffers are donated next; any
     #                          failure before this point kept them valid
     out = fn(interior, jnp.asarray(start, dtype=jnp.int32))
@@ -971,6 +1001,17 @@ def run_shard_map(ctx, start: int, n: int) -> None:
     # the halo fraction applies to the program window it was measured on.
     ctx._run_timer._elapsed += time.perf_counter() - t0r - cal_secs
     ctx._halo_timer._elapsed += frac * dt_call
+    ctx._halo_frac_last = frac
+    if frac > 0:
+        from yask_tpu.obs.tracer import record_span
+        # retroactive span: the calibrated exchange share of THIS
+        # program call (CommPlan execution is inside the jitted scan —
+        # this estimate is the only runtime exchange datum available)
+        record_span("halo.share", "exchange", t0c2_wall,
+                    frac * dt_call, frac=frac,
+                    nperm=ctx._halo_nperm.get(key, 0),
+                    unstable=bool(ctx._halo_cal_unstable.get(key,
+                                                             False)))
 
 
 def _prep_shard_pallas(ctx, n: int, K: int, blk):
@@ -1505,6 +1546,7 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
     ctx._resident = None   # interior buffers are donated next; any
     #                          failure before this point kept them valid
     t0c2 = time.perf_counter()
+    t0c2_wall = time.time()
     out = fn(interior, jnp.asarray(start, dtype=jnp.int32))
     jax.block_until_ready(out)
     dt_call = time.perf_counter() - t0c2
@@ -1514,3 +1556,12 @@ def run_shard_pallas(ctx, start: int, n: int) -> None:
     ctx._state = None
     ctx._run_timer._elapsed += time.perf_counter() - t0r
     ctx._halo_timer._elapsed += frac * dt_call
+    ctx._halo_frac_last = frac
+    if frac > 0:
+        from yask_tpu.obs.tracer import record_span
+        # retroactive exchange-share span (see run_shard_map)
+        record_span("halo.share", "exchange", t0c2_wall,
+                    frac * dt_call, frac=frac,
+                    nperm=ctx._halo_nperm.get(key, 0),
+                    unstable=bool(ctx._halo_cal_unstable.get(key,
+                                                             False)))

@@ -140,8 +140,13 @@ def from_legacy(rec: Dict, source: str, provenance: Dict) -> Dict:
 
 def append_row(row: Dict, path: Optional[str] = None) -> Dict:
     """Validate + append one row; returns the row.  Append-only by
-    contract: nothing in the repo rewrites or deletes ledger lines."""
+    contract: nothing in the repo rewrites or deletes ledger lines.
+    Rows appended under an active trace gain ``trace_id`` (optional
+    field, schema-compatible) so perf evidence joins the span
+    timeline."""
+    from yask_tpu.obs.tracer import stamp_trace
     validate_row(row)
+    stamp_trace(row)
     with open(path or default_ledger_path(), "a") as f:
         f.write(json.dumps(row, sort_keys=True) + "\n")
     return row

@@ -169,22 +169,28 @@ def save_checkpoint(ctx, path: str) -> str:
     """Atomically write ``ctx``'s snapshot to ``path``.  The npz is
     written to ``path + ".tmp"`` through an open file object (so numpy
     cannot append ``.npz`` and break atomicity) and renamed into place.
-    Fault site ``ckpt.save``."""
-    fault_point("ckpt.save")
-    snap = extract_snapshot(ctx)
-    payload = {"__meta__": np.frombuffer(
-        json.dumps(snap["meta"], sort_keys=True).encode(), dtype=np.uint8)}
-    for name, ring in snap["state"].items():
-        for i, a in enumerate(ring):
-            payload[f"{name}__slot{i}"] = a
-    d = os.path.dirname(os.path.abspath(path))
-    os.makedirs(d, exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **payload)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    Fault site ``ckpt.save``; span ``ckpt.save`` (phase
+    ``checkpoint``)."""
+    from yask_tpu.obs.tracer import span
+    with span("ckpt.save", phase="checkpoint", path=path) as sp:
+        fault_point("ckpt.save")
+        snap = extract_snapshot(ctx)
+        payload = {"__meta__": np.frombuffer(
+            json.dumps(snap["meta"], sort_keys=True).encode(),
+            dtype=np.uint8)}
+        for name, ring in snap["state"].items():
+            for i, a in enumerate(ring):
+                payload[f"{name}__slot{i}"] = a
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        sp.set(step=int(snap["meta"].get("cur_step", 0)),
+               nvars=len(snap["state"]))
     return path
 
 
@@ -205,21 +211,28 @@ def restore_checkpoint(ctx, path: str) -> bool:
     """Load ``path`` and apply it to ``ctx``.  Returns ``False`` — never
     raises — when the file is missing/torn/corrupt, carries a stale
     schema, or does not match the context's identity: the caller falls
-    back to a fresh run.  Fault site ``ckpt.restore``."""
-    fault_point("ckpt.restore")
-    try:
-        with np.load(path) as data:
-            meta = json.loads(bytes(data["__meta__"]).decode())
-            if not isinstance(meta, dict) \
-                    or meta.get("schema") != CKPT_SCHEMA:
-                return False
-            state = {}
-            for name, nslots in meta.get("rings", {}).items():
-                state[name] = [np.array(data[f"{name}__slot{i}"])
-                               for i in range(int(nslots))]
-    except Exception:  # noqa: BLE001 - torn/corrupt file → fresh run
-        return False
-    return apply_snapshot(ctx, {"meta": meta, "state": state})
+    back to a fresh run.  Fault site ``ckpt.restore``; span
+    ``ckpt.restore`` (phase ``checkpoint``)."""
+    from yask_tpu.obs.tracer import span
+    with span("ckpt.restore", phase="checkpoint", path=path) as sp:
+        fault_point("ckpt.restore")
+        try:
+            with np.load(path) as data:
+                meta = json.loads(bytes(data["__meta__"]).decode())
+                if not isinstance(meta, dict) \
+                        or meta.get("schema") != CKPT_SCHEMA:
+                    sp.set(ok=False)
+                    return False
+                state = {}
+                for name, nslots in meta.get("rings", {}).items():
+                    state[name] = [np.array(data[f"{name}__slot{i}"])
+                                   for i in range(int(nslots))]
+        except Exception:  # noqa: BLE001 - torn/corrupt → fresh run
+            sp.set(ok=False)
+            return False
+        ok = apply_snapshot(ctx, {"meta": meta, "state": state})
+        sp.set(ok=bool(ok))
+        return ok
 
 
 def snapshot_mismatches(a: Dict, b: Dict, epsilon: float = 1e-4,

@@ -89,18 +89,29 @@ def guarded_call(fn, *args, site: str = "call",
     is raised as its taxonomy type with ``.cause`` holding the
     original.  ``breaker`` (when shared across calls) records every
     fault and suppresses further retries once tripped."""
+    from yask_tpu.obs.tracer import phase_for_site, span
     attempt = 0
     while True:
-        try:
-            with deadline(deadline_secs, site=site):
-                # inside the deadline: an injected "hang" must be
-                # converted to DeviceHang exactly like a real stall
-                fault_point(site)
-                out = fn(*args, **kwargs)
-        except Exception as e:  # noqa: BLE001 - classified right below
-            fault = classify(e, site=site)
-            if fault is None:
-                raise
+        fault = None
+        # one span per attempt (named by the fault site, phase derived
+        # from it) — retries show as sibling spans, and a classified
+        # fault lands in the span's attrs; unclassified exceptions
+        # propagate through the span close untouched
+        with span(f"guard:{site}", phase=phase_for_site(site),
+                  attempt=attempt) as sp:
+            try:
+                with deadline(deadline_secs, site=site):
+                    # inside the deadline: an injected "hang" must be
+                    # converted to DeviceHang exactly like a real
+                    # stall
+                    fault_point(site)
+                    out = fn(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 - classified below
+                fault = classify(e, site=site)
+                if fault is None:
+                    raise
+                sp.set(fault=fault.kind)
+        if fault is not None:
             tripped = breaker.record(fault) if breaker is not None \
                 else False
             if fault.kind in retry_on and attempt < retries \

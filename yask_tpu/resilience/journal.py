@@ -74,10 +74,14 @@ class SessionJournal:
                attempt: int = 1, **detail) -> Dict:
         """Append one row; never fatal to the caller's own work is NOT
         the contract here — journal I/O failures raise, because a
-        session that cannot journal cannot promise resume."""
+        session that cannot journal cannot promise resume.  Rows
+        inherit the thread's active trace id (``stamp_trace``) so a
+        traced run's journal evidence joins TRACE_EVENTS.jsonl."""
+        from yask_tpu.obs.tracer import stamp_trace
         row = {"v": SCHEMA, "stage": str(stage), "case": str(case),
                "attempt": int(attempt), "outcome": str(outcome),
                "ts": _utc_now()}
+        stamp_trace(row)
         if detail:
             row["detail"] = detail
         with open(self.path, "a") as f:

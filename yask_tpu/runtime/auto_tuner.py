@@ -194,18 +194,22 @@ class AutoTuner:
             self.results[key] = float("inf")
             return float("inf")
         self._breaker.reset()
-        # warmup call (not timed — excludes dispatch jitter)
-        call(compiled)
-        calls = 0
-        t0 = time.perf_counter()
-        while time.perf_counter() - t0 < self.trial_secs:
+        from yask_tpu.obs.tracer import span
+        with span("tuner.trial", phase="tune",
+                  candidate=repr(key), k=k) as sp:
+            # warmup call (not timed — excludes dispatch jitter)
             call(compiled)
-            calls += 1
-            if self.best_rate is not None and \
-                    (time.perf_counter() - t0) / (calls * k) \
-                    > 2.0 * self.best_rate:
-                break
-        per_step = (time.perf_counter() - t0) / max(calls * k, 1)
+            calls = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < self.trial_secs:
+                call(compiled)
+                calls += 1
+                if self.best_rate is not None and \
+                        (time.perf_counter() - t0) / (calls * k) \
+                        > 2.0 * self.best_rate:
+                    break
+            per_step = (time.perf_counter() - t0) / max(calls * k, 1)
+            sp.set(per_step=per_step, calls=calls)
         self.results[key] = per_step
         if self.best_rate is None or per_step < self.best_rate:
             self.best_rate = per_step

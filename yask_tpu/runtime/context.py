@@ -549,14 +549,20 @@ class StencilContext:
             self._materialize_state()  # non-shard path needs padded state
         if not self._state_on_device:
             import jax
-            out = {}
-            for k, ring in self._state.items():
-                if self._shardings is not None:
-                    out[k] = [jax.device_put(a, self._shardings[k])
-                              for a in ring]
-                else:
-                    out[k] = [jax.device_put(a) for a in ring]
-            self._state = out
+            from yask_tpu.obs.tracer import span
+            # the host→device staging window is the DMA phase a trace
+            # can actually observe (in-kernel DMA never re-enters
+            # Python)
+            with span("state.to_device", phase="dma",
+                      nvars=len(self._state)):
+                out = {}
+                for k, ring in self._state.items():
+                    if self._shardings is not None:
+                        out[k] = [jax.device_put(a, self._shardings[k])
+                                  for a in ring]
+                    else:
+                        out[k] = [jax.device_put(a) for a in ring]
+                self._state = out
             self._state_on_device = True
 
     # ------------------------------------------------------------------
@@ -640,10 +646,21 @@ class StencilContext:
             wf = self._opts.wf_steps if self._opts.wf_steps > 0 else n
             if self._mode == "shard_pallas":
                 wf = n   # its fusion/grouping happens inside the program
+            from yask_tpu.obs.tracer import span
             t, rem = start, n
             while rem > 0:
                 k = min(wf, rem)
-                runner(self, t, k)
+                with span(f"run.{self._mode}", phase="compute",
+                          first=t, k=k) as sp:
+                    runner(self, t, k)
+                    # the calibrated halo split rides the chunk span:
+                    # obs_report separates exchange from compute with
+                    # it (0.0 = unsplit; unstable cal = no split)
+                    sp.set(halo_frac=float(
+                        getattr(self, "_halo_frac_last", 0.0) or 0.0),
+                        halo_unstable=bool(
+                            getattr(self, "_halo_cal_unstable_last",
+                                    False)))
                 t += k * self._ana.step_dir
                 rem -= k
         else:
@@ -714,6 +731,14 @@ class StencilContext:
             except Exception:  # noqa: BLE001
                 pass
 
+        from yask_tpu.obs.tracer import span as _span
+        # manual enter/exit: the supervised root span brackets the
+        # whole chunk loop without re-indenting it (span ignores
+        # exception info by design — faults are journaled, not traced)
+        _sp = _span("run.supervised", phase="compute",
+                    solution=self.get_name(), steps=n,
+                    ckpt_every=cad, watchdog_every=wd)
+        _sp.__enter__()
         self._in_supervised = True
         try:
             last_good = ckpt.extract_snapshot(self)
@@ -777,6 +802,7 @@ class StencilContext:
                          ladder_path=ladder_path, attempts=attempt)
         finally:
             self._in_supervised = False
+            _sp.__exit__(None, None, None)
 
     def _watchdog_scan(self) -> None:
         """Cheap per-cadence state scan: nonfinite / all-zero written

@@ -351,6 +351,7 @@ class EnsembleRun:
         member 0's run timer (it is the *aggregate* batched time, not
         a per-member cost); every member's ``cur_step``/``steps_done``
         advance as if run alone."""
+        from yask_tpu.obs.tracer import span
         ctx = self._ctx
         ctx._check_prepared()
         if last_step_index is None:
@@ -358,7 +359,9 @@ class EnsembleRun:
         start, n = ctx._step_seq(first_step_index, last_step_index)
 
         try:
-            self._run_batched(start, n)
+            with span("ensemble.run", phase="compute",
+                      members=self.n, steps=n, masked=self.masked):
+                self._run_batched(start, n)
             self.batched_reason = ""
         except YaskException:
             raise
@@ -369,7 +372,11 @@ class EnsembleRun:
             # sequential path restarts cleanly and still shares the
             # context's compiled per-member chunk.
             self.batched_reason = f"{type(e).__name__}: {e}"
-            self._run_sequential(first_step_index, last_step_index)
+            with span("ensemble.sequential", phase="compute",
+                      members=self.n, steps=n,
+                      reason=self.batched_reason[:120]):
+                self._run_sequential(first_step_index,
+                                     last_step_index)
             return
 
         dirn = ctx._ana.step_dir

@@ -96,6 +96,11 @@ class ServeRequest:
     #: by default — a stream event is a progress beacon, the payload
     #: is opt-in because extraction costs a device sync per chunk).
     stream_outputs: bool = False
+    #: upstream trace id (obs.tracer) — the fleet front stamps one per
+    #: client op and the worker threads it through every journal row,
+    #: span, and ledger row this request produces.  "" = none (the
+    #: scheduler mints one only when YT_TRACE is on).
+    trace: str = ""
 
     def steps(self) -> Tuple[int, int]:
         last = self.first_step if self.last_step is None \
@@ -145,6 +150,9 @@ class ServeResponse:
     #: {"step": ..., "outputs": {...}?}) — the wire front forwards
     #: them as they happen; the in-process response also keeps them.
     streams: List[Dict] = field(default_factory=list)
+    #: the trace id this request ran under ("" when untraced) — the
+    #: join key against TRACE_EVENTS.jsonl / journals / PERF_LEDGER.
+    trace: str = ""
 
     @property
     def ok(self) -> bool:

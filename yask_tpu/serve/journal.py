@@ -81,17 +81,27 @@ class ServeJournal:
 
     # ---------------------------------------------------------- write
     def record(self, rid: str, session: str, event: str,
-               **detail) -> Dict:
+               trace_id: str = "", **detail) -> Dict:
         """Append one lifecycle row.  Unlike the session journal this
         never raises: serving must survive a read-only journal dir (a
         tenant's answer cannot depend on evidence I/O), so failures
-        return the row un-persisted."""
+        return the row un-persisted.
+
+        ``trace_id`` joins the row against TRACE_EVENTS.jsonl — the
+        scheduler passes each request's own id explicitly (one batch
+        can mix traces); callers without one inherit the thread's
+        active trace via ``stamp_trace``."""
+        from yask_tpu.obs.tracer import stamp_trace
         if event not in SERVE_EVENTS:
             raise ValueError(f"unknown serve journal event {event!r}; "
                              f"one of {SERVE_EVENTS}")
         row = {"v": SERVE_SCHEMA, "rid": str(rid),
                "session": str(session), "event": str(event),
                "ts": _utc_now()}
+        if trace_id:
+            row["trace_id"] = str(trace_id)
+        else:
+            stamp_trace(row)
         if detail:
             row["detail"] = detail
         try:

@@ -58,6 +58,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from yask_tpu.obs import tracer as obs
+from yask_tpu.obs.metrics import Registry
 from yask_tpu.serve.api import (ServeRequest, ServeResponse,
                                 serve_deadline_secs, serve_max_batch,
                                 serve_window_secs)
@@ -107,14 +109,22 @@ class _Pending:
     mutable accumulators survive preemption rounds (a preempted
     request re-enters the queue as its own continuation)."""
 
-    __slots__ = ("req", "rid", "t_received", "done", "response",
-                 "run_secs", "compile_secs", "cache_hit", "preempts",
-                 "streams", "on_stream")
+    __slots__ = ("req", "rid", "t_received", "t_wall", "done",
+                 "response", "run_secs", "compile_secs", "cache_hit",
+                 "preempts", "streams", "on_stream", "trace")
 
     def __init__(self, req: ServeRequest, rid: str):
         self.req = req
         self.rid = rid
         self.t_received = time.perf_counter()
+        self.t_wall = time.time()
+        # ONE trace id per request lifecycle: the wire front's stamped
+        # id wins, else an ambient activation (in-process callers),
+        # else mint one when tracing is on.  "" = untraced (rows stay
+        # bit-identical to the pre-obs schema).
+        self.trace = (req.trace or obs.current_trace_id()
+                      or (obs.new_trace_id() if obs.trace_enabled()
+                          else ""))
         self.done = threading.Event()
         self.response: Optional[ServeResponse] = None
         self.run_secs = 0.0
@@ -135,10 +145,12 @@ class BatchScheduler:
     def __init__(self, registry: SessionRegistry,
                  journal: Optional[ServeJournal] = None,
                  window_secs: Optional[float] = None,
-                 max_batch: Optional[int] = None):
+                 max_batch: Optional[int] = None,
+                 obs_registry: Optional[Registry] = None):
         from yask_tpu.resilience.faults import Breaker
         self._registry = registry
         self._journal = journal or ServeJournal()
+        self._obs = obs_registry or Registry()
         self._window = serve_window_secs() if window_secs is None \
             else max(0.0, float(window_secs))
         self._max_batch = serve_max_batch() if max_batch is None \
@@ -170,6 +182,7 @@ class BatchScheduler:
             p = _Pending(req, rid)
             p.on_stream = on_stream
             self._journal.record(rid, req.session, "received",
+                                 trace_id=p.trace,
                                  first=req.steps()[0],
                                  last=req.steps()[1])
             if self._shutdown:
@@ -312,9 +325,11 @@ class BatchScheduler:
 
     def _reject(self, p: _Pending, why: str) -> ServeResponse:
         self._journal.record(p.rid, p.req.session, "rejected",
-                             error=why[:200])
+                             trace_id=p.trace, error=why[:200])
+        self._obs.counter("serve.requests.rejected").inc()
         return ServeResponse(rid=p.rid, session=p.req.session,
-                             status="rejected", error=why)
+                             status="rejected", error=why,
+                             trace=p.trace)
 
     def _execute(self, batch: List[_Pending]) -> None:
         """One scheduling turn for a collected batch: journal the
@@ -337,7 +352,7 @@ class BatchScheduler:
             if p.req.flush_every > 0:
                 detail["flush_every"] = int(p.req.flush_every)
             self._journal.record(p.rid, p.req.session, "batched",
-                                 **detail)
+                                 trace_id=p.trace, **detail)
         cadences = [int(p.req.flush_every) for p in batch
                     if p.req.flush_every > 0]
         span = abs(last - first) + 1
@@ -395,29 +410,44 @@ class BatchScheduler:
 
             batched = False
             fault: Optional[Fault] = None
+            # the head's trace id scopes the batch span (a batch can
+            # mix traces; journal rows carry each member's own id) —
+            # activation also stamps any ledger/session-journal rows
+            # the run produces underneath.
             try:
-                # the batching decision's injection site: a classified
-                # fault here takes the same degrade path as serve.run
-                fault_point("serve.batch")
-                if n > 1 or masked:
-                    # bucketed members run masked even at occupancy 1:
-                    # a sub-domain session's state is only correct
-                    # under the per-step sub-domain mask
-                    ens = EnsembleRun(
-                        ctx, members=[s.run_state for s in sessions],
-                        sub_domains=([s.sub_sizes for s in sessions]
-                                     if masked else None))
-                    guarded_call(ens.run, first, last,
-                                 site="serve.run", deadline_secs=ddl)
-                    batched = ens.batched_reason == "" and n > 1
-                else:
-                    prev = ctx.set_run_state(sessions[0].run_state)
-                    try:
-                        guarded_call(ctx.run_solution, first, last,
+                with obs.activate(batch[0].trace), \
+                        obs.span("serve.chunk", phase="compute",
+                                 batch=n, first=first, last=last,
+                                 mode=sessions[0].mode,
+                                 rids=[p.rid for p in batch]):
+                    # the batching decision's injection site: a
+                    # classified fault here takes the same degrade
+                    # path as serve.run
+                    fault_point("serve.batch")
+                    if n > 1 or masked:
+                        # bucketed members run masked even at
+                        # occupancy 1: a sub-domain session's state is
+                        # only correct under the per-step sub-domain
+                        # mask
+                        ens = EnsembleRun(
+                            ctx,
+                            members=[s.run_state for s in sessions],
+                            sub_domains=([s.sub_sizes
+                                          for s in sessions]
+                                         if masked else None))
+                        guarded_call(ens.run, first, last,
                                      site="serve.run",
                                      deadline_secs=ddl)
-                    finally:
-                        ctx.set_run_state(prev)
+                        batched = ens.batched_reason == "" and n > 1
+                    else:
+                        prev = ctx.set_run_state(
+                            sessions[0].run_state)
+                        try:
+                            guarded_call(ctx.run_solution, first,
+                                         last, site="serve.run",
+                                         deadline_secs=ddl)
+                        finally:
+                            ctx.set_run_state(prev)
             except Fault as f:
                 fault = f
             except YaskException as e:
@@ -436,7 +466,8 @@ class BatchScheduler:
                 tripped = self._breaker.record(fault)
                 for p, sess in zip(batch, sessions):
                     self._journal.record(
-                        p.rid, sess.sid, "fault", kind=fault.kind,
+                        p.rid, sess.sid, "fault", trace_id=p.trace,
+                        kind=fault.kind,
                         site=getattr(fault, "site", "serve.run"),
                         mode=sess.mode, batch=n,
                         breaker_tripped=bool(tripped))
@@ -478,6 +509,7 @@ class BatchScheduler:
                              site="serve.flush")
             except Fault as f:
                 self._journal.record(p.rid, sess.sid, "fault",
+                                     trace_id=p.trace,
                                      kind=f.kind, site="serve.flush",
                                      nonfatal=True)
 
@@ -497,6 +529,7 @@ class BatchScheduler:
                 finally:
                     ctx.set_run_state(prev)
         self._journal.record(p.rid, sess.sid, "stream",
+                             trace_id=p.trace,
                              step=int(step_done),
                              chunk=len(p.streams),
                              outputs=sorted(ev.get("outputs", ())))
@@ -524,6 +557,7 @@ class BatchScheduler:
                 p.req.last_step = int(last)
                 p.preempts += 1
                 self._journal.record(p.rid, p.req.session, "preempted",
+                                     trace_id=p.trace,
                                      resume_at=int(next_first),
                                      last=int(last))
             sids = {p.req.session for p in batch}
@@ -545,10 +579,6 @@ class BatchScheduler:
         last committed snapshot, over the REMAINING step range; the
         tenant gets a degraded-mode answer unless the ladder (or the
         breaker) is exhausted."""
-        from yask_tpu.resilience.checkpoint import (apply_snapshot,
-                                                    degradation_ladder)
-        from yask_tpu.resilience.faults import Fault
-        from yask_tpu.resilience.guard import guarded_call
         if tripped:
             return self._reject(
                 p, f"{fault.kind} at serve.run and the breaker is "
@@ -563,6 +593,17 @@ class BatchScheduler:
         ddl = p.req.deadline_secs or serve_deadline_secs()
         last_err: Exception = fault
         t0 = time.perf_counter()
+        with obs.activate(p.trace):
+            return self._recover_laddered(
+                p, sess, snap, fault, first, last, ddl, last_err, t0)
+
+    def _recover_laddered(self, p: _Pending, sess: Session, snap: Dict,
+                          fault, first: int, last: int, ddl, last_err,
+                          t0: float) -> ServeResponse:
+        from yask_tpu.resilience.checkpoint import (apply_snapshot,
+                                                    degradation_ladder)
+        from yask_tpu.resilience.faults import Fault
+        from yask_tpu.resilience.guard import guarded_call
         for to_mode in degradation_ladder(sess.mode):
             try:
                 ctx2 = sess.profile.ctx_for(to_mode)
@@ -581,6 +622,7 @@ class BatchScheduler:
                              site="serve.run", deadline_secs=ddl)
             except Fault as f2:
                 self._journal.record(p.rid, sess.sid, "fault",
+                                     trace_id=p.trace,
                                      kind=f2.kind, mode=to_mode)
                 if self._breaker.record(f2):
                     last_err = f2
@@ -594,6 +636,7 @@ class BatchScheduler:
             sess.degrade_path.append(to_mode)
             self._breaker.reset()
             self._journal.record(p.rid, sess.sid, "degraded",
+                                 trace_id=p.trace,
                                  to_mode=to_mode, kind=fault.kind,
                                  ladder_path=list(sess.degrade_path))
             return self._release(
@@ -622,7 +665,13 @@ class BatchScheduler:
             compile_secs=compile_secs, cache_hit=cache_hit,
             bucket=(sess.bucket.as_detail()
                     if sess.bucket is not None else {}),
-            preempted=p.preempts, streams=list(p.streams))
+            preempted=p.preempts, streams=list(p.streams),
+            trace=p.trace)
+        # the queue-wait interval as a retroactive span: the phase
+        # breakdown must separate waiting from running
+        obs.record_span("serve.queue_wait", "queue", p.t_wall,
+                        queue_secs, trace=p.trace, rid=p.rid,
+                        session=sess.sid)
         try:
             with self._dev_lock:
                 ctx = sess.ctx
@@ -640,6 +689,7 @@ class BatchScheduler:
         if verdict["ok"]:
             resp.status = "ok"
             self._journal.record(p.rid, sess.sid, "ok", batch=batch,
+                                 trace_id=p.trace,
                                  batched=batched, mode=sess.mode,
                                  degraded=sess.degraded,
                                  preempted=p.preempts)
@@ -650,6 +700,7 @@ class BatchScheduler:
             resp.status = "anomaly"
             resp.anomaly = anomaly_fields(verdict)["anomaly"]
             self._journal.record(p.rid, sess.sid, "anomaly",
+                                 trace_id=p.trace,
                                  batch=batch, mode=sess.mode,
                                  anomalies=verdict["anomalies"])
         with self._lock:
@@ -658,9 +709,22 @@ class BatchScheduler:
                 "batched": batched, "mode": sess.mode,
                 "degraded": sess.degraded,
                 "bucketed": bool(sess.sub_sizes),
-                "preempted": p.preempts,
+                "preempted": p.preempts, "trace": p.trace,
                 "queue_secs": queue_secs, "run_secs": run_secs,
                 "compile_secs": compile_secs, "cache_hit": cache_hit})
             if len(self._samples) > MAX_SAMPLES:
                 del self._samples[:len(self._samples) - MAX_SAMPLES]
+        reg = self._obs
+        reg.counter(f"serve.requests.{resp.status}").inc()
+        reg.counter(f"serve.cache.{cache_hit}").inc()
+        if sess.degraded:
+            reg.counter("serve.degraded").inc()
+        if p.preempts:
+            reg.counter("serve.preempted").inc()
+        reg.histogram("serve.queue_ms").observe(queue_secs * 1e3)
+        reg.histogram("serve.run_ms").observe(run_secs * 1e3)
+        reg.histogram("serve.total_ms").observe(
+            (queue_secs + run_secs) * 1e3)
+        reg.histogram("serve.batch_occupancy").observe(batch)
+        reg.gauge("serve.queue_depth").set(self.queue_depth())
         return resp

@@ -36,18 +36,11 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from yask_tpu.obs.metrics import Registry, percentile as _pctl
 from yask_tpu.serve.api import ServeRequest, ServeResponse
 from yask_tpu.serve.journal import ServeJournal
 from yask_tpu.serve.registry import SessionRegistry
 from yask_tpu.serve.scheduler import BatchScheduler
-
-
-def _pctl(xs: List[float], q: float) -> float:
-    if not xs:
-        return 0.0
-    xs = sorted(xs)
-    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
-    return xs[i]
 
 
 class StencilServer:
@@ -66,9 +59,13 @@ class StencilServer:
         # servers is the safe compaction window).
         self.journal.compact_if_large()
         self.registry = SessionRegistry(self._factory, self._env)
+        #: per-server metrics registry (obs.metrics) — the scheduler
+        #: feeds it per release; ``metrics()["registry"]`` exports it.
+        self.obs = Registry()
         self.scheduler = BatchScheduler(self.registry, self.journal,
                                         window_secs=window_secs,
-                                        max_batch=max_batch)
+                                        max_batch=max_batch,
+                                        obs_registry=self.obs)
         self._preflight = bool(preflight)
         #: last serve-pass CheckReport (LOG-ONLY evidence).
         self.last_preflight = None
@@ -312,6 +309,10 @@ class StencilServer:
             "compile_ms_total": round(sum(s["compile_secs"]
                                           for s in done) * 1e3, 1),
             "cache_hits": hits,
+            # the obs registry's own view (same percentile math —
+            # obs.metrics.percentile IS the historical _pctl); rides
+            # op_metrics to the fleet front as the per-worker export.
+            "registry": self.obs.snapshot(),
         }
 
     def flush_metrics(self) -> List[Dict]:
@@ -326,6 +327,15 @@ class StencilServer:
             return []
         plat = self._env.get_platform()
         prov = capture_provenance(platform=plat)
+        # aggregate rows cover many requests — the distinct trace ids
+        # in the sampled window ride along so a ledger row joins back
+        # to the span timelines it summarizes (newest 32, bounded).
+        tids: List[str] = []
+        for s in self.scheduler.samples():
+            t = s.get("trace")
+            if t and t not in tids:
+                tids.append(t)
+        tids = tids[-32:]
         rows = []
         for key, value, unit in (
                 ("serve p50 total latency", m["p50_total_ms"], "ms"),
@@ -344,7 +354,9 @@ class StencilServer:
                                  "p50_run_ms": m["p50_run_ms"],
                                  "occupancy_max":
                                      m["batch_occupancy_max"],
-                                 "cache_hits": m["cache_hits"]}))
+                                 "cache_hits": m["cache_hits"],
+                                 **({"trace_ids": tids}
+                                    if tids else {})}))
             except Exception:  # noqa: BLE001 - ledger I/O must never
                 pass           # break serving
         return rows

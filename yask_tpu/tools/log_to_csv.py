@@ -14,6 +14,7 @@ Usage::
 
     python -m yask_tpu.tools.log_to_csv run1.log run2.log > perf.csv
     python -m yask_tpu.tools.log_to_csv --ledger [PERF_LEDGER.jsonl] > perf.csv
+    python -m yask_tpu.tools.log_to_csv --traces [TRACE_EVENTS.jsonl] > spans.csv
 """
 
 from __future__ import annotations
@@ -124,14 +125,44 @@ def ledger_to_csv(path: str = "", out=None) -> int:
     return len(rows)
 
 
+#: Trace columns, identity → placement → timing → payload.
+TRACE_COLS = [
+    "trace", "span", "parent", "name", "phase",
+    "ts", "dur", "pid", "tid", "attrs",
+]
+
+
+def traces_to_csv(path: str = "", out=None) -> int:
+    """Flatten obs span rows (``TRACE_EVENTS.jsonl``, schema
+    ``yask_tpu.trace/1``) to CSV — attrs as one JSON column; returns
+    the number of rows written.  The spreadsheet analog of
+    ``tools/obs_report.py``."""
+    import json
+
+    from yask_tpu.obs.tracer import default_trace_path, read_spans
+    out = out or sys.stdout
+    rows = read_spans(path or default_trace_path())
+    w = csv.DictWriter(out, fieldnames=TRACE_COLS, extrasaction="ignore")
+    w.writeheader()
+    for r in rows:
+        w.writerow({**{k: r.get(k) for k in TRACE_COLS if k != "attrs"},
+                    "attrs": json.dumps(r.get("attrs", {}),
+                                        sort_keys=True)})
+    return len(rows)
+
+
 def main() -> None:  # pragma: no cover - thin wrapper
     args = sys.argv[1:]
     if args and args[0] == "--ledger":
         ledger_to_csv(args[1] if len(args) > 1 else "")
         return
+    if args and args[0] == "--traces":
+        traces_to_csv(args[1] if len(args) > 1 else "")
+        return
     if not args:
         sys.stderr.write(
-            "usage: log_to_csv <log> [log...] | --ledger [path]\n")
+            "usage: log_to_csv <log> [log...] | --ledger [path] | "
+            "--traces [path]\n")
         sys.exit(2)
     logs_to_csv(args)
 
