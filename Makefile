@@ -7,8 +7,8 @@ TEST_ENV ?= PALLAS_AXON_POOL_IPS=
 
 .PHONY: all native capi test test-fast scratch-tests boundary-tests \
         stages-tests mode-tests bench perfcheck faultcheck commcheck \
-        cachecheck servecheck obscheck examples clean list-stencils \
-        lint check
+        cachecheck servecheck obscheck telemetrycheck examples clean \
+        list-stencils lint check
 
 all: native test
 
@@ -81,10 +81,20 @@ obscheck: lint
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m pytest \
 		tests/test_obs.py -q
 
+# the telemetry plane over the obs spine: fleet snapshot merging
+# (pooled histogram samples, never averaged percentiles), Prometheus
+# exposition + name stability, SLO burn-rate breach/non-breach
+# windows, the measured-vs-modeled attribution join on a traced run,
+# and the no-op guarantee with YT_TRACE unset (see
+# docs/observability.md)
+telemetrycheck: lint
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_telemetry.py -q
+
 # static checker over the flagship configs: Mosaic legality, VMEM
 # feasibility (incl. the round-3 spill-OOM class), races, explain.
 # See docs/checking.md; nonzero exit on any error-severity finding.
-check: cachecheck servecheck obscheck
+check: cachecheck servecheck obscheck telemetrycheck
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker \
 		-stencil iso3dfd -radius 8 -g 256 -mode pallas -wf_steps 2
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker -all_stencils

@@ -113,6 +113,13 @@ def test_disabled_supervised_run_bit_identical_to_traced(tmp_path,
     plain = _mk_iso("jit", ckpt_every=2, ckpt_dir=str(tmp_path))
     plain.run_solution(0, STEPS - 1)
     assert not off_file.exists()
+    # the telemetry plane is off too: no YT_SLO_* knob → no monitor
+    from yask_tpu.obs.slo import SloMonitor, slo_enabled
+    for k in list(os.environ):
+        if k.startswith("YT_SLO_"):
+            monkeypatch.delenv(k)
+    assert not slo_enabled()
+    assert SloMonitor.from_env() is None
 
     on_file = tmp_path / "on.jsonl"
     monkeypatch.setenv("YT_TRACE_EVENTS", str(on_file))

@@ -197,7 +197,7 @@ def test_bare_device_call_unguarded_sibling_still_fires(tmp_path):
             ctx.run_solution(0, 9)
 
         def main(ctx):
-            guarded_call(guarded_fn, ctx, site="x")
+            guarded_call(guarded_fn, ctx, site="bench.x")
             bare_fn(ctx)
     """)
     assert fired(fs) == ["BARE-DEVICE-CALL"]
@@ -355,6 +355,50 @@ def test_trace_id_pragma_and_tests_scope(tmp_path):
     assert fired(lint_src(tmp_path, bare)) == ["TRACE-ID"]
     # tests/ fixture writers are out of scope
     assert lint_tool(tmp_path, bare,
+                     name=os.path.join("tests", "t.py")) == []
+
+
+def test_phase_site_fires_on_unmapped_literal(tmp_path):
+    # a site the tracer's phase table maps to the "guard" catch-all is
+    # invisible in the per-phase breakdown — new sites must land on a
+    # real phase prefix (or extend the table)
+    fs = lint_src(tmp_path, """\
+        def f(x):
+            fault_point("mystery.site")
+            return maybe_corrupt("unmapped.thing", x)
+    """)
+    assert sorted(fired(fs)) == ["PHASE-SITE", "PHASE-SITE"]
+
+
+def test_phase_site_mapped_and_dynamic_sites_pass(tmp_path):
+    fs = lint_src(tmp_path, """\
+        def f(fn, x, name):
+            fault_point("ckpt.save")
+            guarded_call(fn, x, site="bench.measure")
+            fault_point(f"suite.{name}")        # mapped f-string head
+            guarded_call(fn, x, site=name)      # dynamic: not checkable
+    """)
+    assert fs == []
+
+
+def test_phase_site_fires_on_unmapped_fstring_head(tmp_path):
+    fs = lint_src(tmp_path, """\
+        def f(name):
+            fault_point(f"mystery.{name}")
+    """)
+    assert fired(fs) == ["PHASE-SITE"]
+
+
+def test_phase_site_pragma_and_tests_scope(tmp_path):
+    src = """\
+        def f():
+            fault_point("mystery.site")
+    """
+    ok = src.replace('"mystery.site")',
+                     '"mystery.site")  # lint: phase-site-ok')
+    assert lint_src(tmp_path, ok) == []
+    # tests/ fixtures invent sites freely
+    assert lint_tool(tmp_path, src,
                      name=os.path.join("tests", "t.py")) == []
 
 

@@ -245,9 +245,7 @@ def test_shard_map_cache_keyed_on_overlap(env):
     assert len(keys) == 2 and len({k[2] for k in keys}) == 2
 
 
-def test_halo_time_measured(env):
-    """-measure_halo calibrates a no-exchange twin and attributes a real,
-    plausible halo fraction of shard_map run time (VERDICT r1 item 7)."""
+def _halo_measured_ctx(env):
     ctx = yk_factory().new_solution(env, stencil="3axis", radius=1)
     # overlap off so exchange cost cannot be fully hidden (a perfectly
     # overlapped run may legitimately calibrate to a zero fraction)
@@ -259,11 +257,29 @@ def test_halo_time_measured(env):
     ctx.get_var("A").set_elements_in_seq(0.1)
     ctx.run_solution(0, 7)
     st = ctx.get_stats()
+    # variant key = (mode, steps, overlap) + the comm-schedule plan key
+    frac = ctx._halo_frac.get(
+        ("shard_map", 8, False) + ctx.comm_plan().key())
+    return ctx, st, frac
+
+
+def test_halo_time_measured(env):
+    """-measure_halo calibrates a no-exchange twin and attributes a real,
+    plausible halo fraction of shard_map run time (VERDICT r1 item 7)."""
+    ctx, st, frac = _halo_measured_ctx(env)
+    if (frac is None or st.get_halo_exchange_secs() <= 0.0
+            or st.get_halo_pack_secs() <= 0.0):
+        # ONE bounded re-measure, mirroring halo-cal's own outlier
+        # re-time: under the full parallel tier-1 run, suite load can
+        # make the no-exchange twin split twice-unstable (frac None)
+        # or clamp a timed component to 0 — neither says the
+        # measurement plumbing is broken, only that this sample was
+        # noise.  A second clean sample is a real pass; a second noisy
+        # one is a real failure.
+        ctx, st, frac = _halo_measured_ctx(env)
     # the calibrated fraction is wall-clock-derived: bound it rather
     # than demanding strict positivity (timing noise can clamp it to 0)
-    # variant key = (mode, steps, overlap) + the comm-schedule plan key
-    frac = ctx._halo_frac[("shard_map", 8, False) + ctx.comm_plan().key()]
-    assert 0.0 <= frac < 1.0
+    assert frac is not None and 0.0 <= frac < 1.0
     assert st.get_halo_secs() <= st.get_elapsed_secs()
     assert "halo-fraction" in st.format()
     # second calibration point: one bare exchange round timed alone

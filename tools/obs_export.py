@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Prometheus text exposition for yask_tpu telemetry snapshots.
+
+Renders a metrics snapshot — a single server's
+``StencilServer.metrics_snapshot()`` or a fleet's merged
+``op metrics_snapshot`` reply — as Prometheus text exposition
+(``yask_tpu.obs.telemetry.to_prometheus``): counters and gauges get
+``# TYPE`` lines plus per-worker ``{worker="w0"}`` labels on fleet
+snapshots; histograms export as summaries (``quantile="0.5"|"0.99"``,
+``_count``/``_sum``/``_max``).  Names derive mechanically from registry
+names (``serve.total_ms`` → ``yt_serve_total_ms``) — the stable set is
+pinned by ``tests/test_telemetry.py``.
+
+Two sources::
+
+    python tools/obs_export.py --snapshot snap.json     # a saved reply
+    python tools/obs_export.py --port 7421              # a live front
+
+``--port`` speaks the JSON-lines protocol to a running ``serve.py`` /
+``serve_fleet.py`` front, sends one ``{"op": "metrics_snapshot"}``, and
+renders the answer — the shape a node-exporter-style scrape wrapper
+would loop on.  No device work, no jax import.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from yask_tpu.obs.telemetry import to_prometheus
+
+
+def _unwrap(doc: Dict) -> Dict:
+    """Accept any of: a raw snapshot, a ``{"snapshot": ...}`` serve
+    reply, or a ``{"telemetry": ...}`` fleet reply."""
+    if not isinstance(doc, dict):
+        return {}
+    for key in ("telemetry", "snapshot"):
+        if isinstance(doc.get(key), dict):
+            return doc[key]
+    return doc
+
+
+def export_snapshot(doc: Dict, prefix: str = "yt") -> str:
+    return to_prometheus(_unwrap(doc), prefix=prefix)
+
+
+def fetch_live(host: str, port: int) -> Dict:
+    """One ``metrics_snapshot`` round-trip against a live front."""
+    from tools.serve_client import ServeClient
+    client = ServeClient.connect(host=host, port=port)
+    try:
+        return client.call("metrics_snapshot")
+    finally:
+        client.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Prometheus text exposition of a telemetry "
+                    "snapshot")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--snapshot", metavar="FILE",
+                     help="a saved snapshot / op-reply JSON file "
+                          "('-' = stdin)")
+    src.add_argument("--port", type=int,
+                     help="poll a live serve/serve_fleet front on TCP")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--prefix", default="yt",
+                    help="metric name prefix (default: yt)")
+    args = ap.parse_args(argv)
+
+    if args.snapshot:
+        raw = (sys.stdin.read() if args.snapshot == "-"
+               else open(args.snapshot).read())
+        doc = json.loads(raw)
+    else:
+        doc = fetch_live(args.host, args.port)
+    text = export_snapshot(doc, prefix=args.prefix)
+    sys.stdout.write(text)
+    return 0 if text else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

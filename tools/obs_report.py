@@ -31,7 +31,16 @@ Usage::
     python tools/obs_report.py --trace t4f2ab...    # one trace
     python tools/obs_report.py --trace all          # everything
     python tools/obs_report.py --perfetto out.json  # + Perfetto dump
+    python tools/obs_report.py --attribution        # measured-vs-
+                                                    #   modeled table
+    python tools/obs_report.py --bank               # bank one
+                                                    #   attribution row
     python -m yask_tpu.tools.log_to_csv --traces    # flat CSV instead
+
+The span math (``pick_trace`` / ``self_times`` / ``phase_breakdown`` /
+``halo_cal_status``) lives in ``yask_tpu.obs.attribution`` and is
+re-exported here — one implementation for the terminal report, the CSV
+exporter, and the attribution ledger rows.
 
 No device work, no jax import — safe to run anywhere, any time.
 """
@@ -46,73 +55,13 @@ from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from yask_tpu.obs.attribution import (  # noqa: F401  (re-exports)
+    halo_cal_status,
+    phase_breakdown,
+    pick_trace,
+    self_times,
+)
 from yask_tpu.obs.tracer import PHASES, default_trace_path, read_spans
-
-
-def pick_trace(rows: List[Dict], trace: str = "") -> List[Dict]:
-    """Filter rows to one trace id; default = the LATEST trace (the one
-    whose newest span has the greatest wall ts); ``"all"`` keeps every
-    row."""
-    if trace == "all":
-        return list(rows)
-    if not trace:
-        latest: Dict[str, float] = {}
-        for r in rows:
-            t = r.get("trace", "")
-            latest[t] = max(latest.get(t, 0.0), float(r.get("ts", 0.0)))
-        if not latest:
-            return []
-        trace = max(latest, key=lambda t: latest[t])
-    return [r for r in rows if r.get("trace") == trace]
-
-
-def self_times(rows: List[Dict]) -> Dict[str, float]:
-    """span id → duration minus direct children's durations (floored
-    at 0 — children on other threads can overlap their parent)."""
-    child_dur: Dict[str, float] = {}
-    for r in rows:
-        p = r.get("parent", "")
-        if p:
-            child_dur[p] = child_dur.get(p, 0.0) + float(r.get("dur", 0.0))
-    return {r["span"]: max(0.0, float(r.get("dur", 0.0))
-                           - child_dur.get(r.get("span", ""), 0.0))
-            for r in rows if "span" in r}
-
-
-def phase_breakdown(rows: List[Dict]) -> Dict[str, Dict]:
-    """Per-phase ``{secs, count}`` from self-times, with ``halo.share``
-    exchange evidence moved out of the compute bucket (it measures a
-    slice of a compute span's interval, not a nested child)."""
-    selfs = self_times(rows)
-    out: Dict[str, Dict] = {}
-    halo_share = 0.0
-    for r in rows:
-        ph = r.get("phase") or "other"
-        b = out.setdefault(ph, {"secs": 0.0, "count": 0})
-        b["secs"] += selfs.get(r.get("span", ""), 0.0)
-        b["count"] += 1
-        if r.get("name") == "halo.share":
-            halo_share += float(r.get("dur", 0.0))
-    if halo_share > 0 and "compute" in out:
-        out["compute"]["secs"] = max(
-            0.0, out["compute"]["secs"] - halo_share)
-        out["compute"]["halo_share_moved"] = halo_share
-    return out
-
-
-def halo_cal_status(rows: List[Dict]) -> Dict:
-    """Aggregate the halo-calibration spans: rep/spread evidence plus
-    whether any calibration came out UNSTABLE (ledger parity — an
-    unstable split is noise, not a halo datum)."""
-    cals = [r for r in rows if r.get("name") == "halo_cal"]
-    att = [r.get("attrs", {}) for r in cals]
-    return {
-        "count": len(cals),
-        "reps": sum(int(a.get("reps", 0) or 0) for a in att),
-        "max_spread": max([float(a.get("spread", 0.0) or 0.0)
-                           for a in att] or [0.0]),
-        "unstable": sum(1 for a in att if a.get("unstable")),
-    }
 
 
 def report(rows: List[Dict], top: int = 10, out=None) -> None:
@@ -160,10 +109,52 @@ def report(rows: List[Dict], top: int = 10, out=None) -> None:
                   f"{r.get('name', '?'):<24} {attrs}\n")
 
 
+def counter_events(rows: List[Dict]) -> List[Dict]:
+    """Counter tracks (``ph: "C"``) derived from the span stream, so
+    Perfetto shows LOAD on the same timeline as latency:
+
+    * ``serve.batch_occupancy`` — each ``serve.chunk`` span's ``batch``
+      attr, raised at the chunk start and dropped back to 0 at its end;
+    * ``serve.queue_depth`` — the number of concurrently open
+      ``serve.queue_wait`` intervals, stepped at each edge.
+
+    Both are per-pid tracks (a fleet trace gets one pair per worker)."""
+    events: List[Dict] = []
+    for r in rows:
+        if r.get("name") != "serve.chunk":
+            continue
+        ts = float(r.get("ts", 0.0)) * 1e6
+        dur = float(r.get("dur", 0.0)) * 1e6
+        pid = r.get("pid", 0)
+        occ = r.get("attrs", {}).get("batch", 1)
+        events.append({"ph": "C", "name": "serve.batch_occupancy",
+                       "ts": ts, "pid": pid, "tid": 0,
+                       "args": {"occupancy": occ}})
+        events.append({"ph": "C", "name": "serve.batch_occupancy",
+                       "ts": ts + dur, "pid": pid, "tid": 0,
+                       "args": {"occupancy": 0}})
+    edges: List[tuple] = []
+    for r in rows:
+        if r.get("name") != "serve.queue_wait":
+            continue
+        ts = float(r.get("ts", 0.0)) * 1e6
+        pid = r.get("pid", 0)
+        edges.append((ts, 1, pid))
+        edges.append((ts + float(r.get("dur", 0.0)) * 1e6, -1, pid))
+    depth: Dict[int, int] = {}
+    for ts, d, pid in sorted(edges):
+        depth[pid] = depth.get(pid, 0) + d
+        events.append({"ph": "C", "name": "serve.queue_depth",
+                       "ts": ts, "pid": pid, "tid": 0,
+                       "args": {"depth": depth[pid]}})
+    return events
+
+
 def to_perfetto(rows: List[Dict]) -> Dict:
     """Chrome trace-event JSON: ``ph: "X"`` complete events in µs on
     the wall clock, one lane per (pid, tid), phase as the category,
-    span/trace ids + attrs in ``args``."""
+    span/trace ids + attrs in ``args``; plus the derived ``ph: "C"``
+    load counter tracks (:func:`counter_events`)."""
     events: List[Dict] = []
     for pid in sorted({r.get("pid", 0) for r in rows}):
         events.append({"ph": "M", "name": "process_name", "pid": pid,
@@ -183,9 +174,49 @@ def to_perfetto(rows: List[Dict]) -> Dict:
                      "parent": r.get("parent", ""),
                      **r.get("attrs", {})},
         })
+    events.extend(counter_events(rows))
     return {"traceEvents": events,
             "displayTimeUnit": "ms",
             "metadata": {"schema": "yask_tpu.trace/1"}}
+
+
+def attribution_report(ledger_rows: List[Dict], top: int = 10,
+                       out=None) -> int:
+    """Render the ``source: "attribution"`` ledger rows as a
+    measured-vs-modeled table, worst-efficiency phases first.
+    Quarantined and halo-cal-unstable rows are excluded (their wall
+    time attributes nothing / their exchange split is noise).  Returns
+    the number of attribution rows rendered."""
+    out = out or sys.stdout
+    rows = [r for r in ledger_rows
+            if r.get("source") == "attribution"
+            and not r.get("quarantined")]
+    kept = [r for r in rows
+            if not (r.get("extra") or {}).get("halo_cal_unstable")]
+    if not kept:
+        out.write("no attribution rows\n")
+        return 0
+    entries = []
+    for r in kept:
+        ex = r.get("extra") or {}
+        for ph, d in sorted((ex.get("phases") or {}).items()):
+            entries.append((d.get("efficiency"), r, ph, d))
+    # worst efficiency first; phases with no model sort last
+    entries.sort(key=lambda t: (t[0] is None, t[0] or 0.0))
+    out.write(f"{'key':<28} {'phase':<12} {'measured':>10} "
+              f"{'modeled':>10} {'eff':>6} {'share':>6}\n")
+    for eff, r, ph, d in entries[:top]:
+        drift = (r.get("guard") or {}).get("status") == "drift"
+        out.write(f"{r.get('key', '?')[:28]:<28} {ph:<12} "
+                  f"{d.get('measured_secs', 0.0):>9.4f}s "
+                  f"{('%9.4fs' % d['modeled_secs']) if 'modeled_secs' in d else '        -':>10} "
+                  f"{('%5.2f' % eff) if eff is not None else '    -':>6} "
+                  f"{d.get('share', 0.0):>6.2f}"
+                  f"{'  DRIFT' if drift else ''}\n")
+    skipped = len(rows) - len(kept)
+    if skipped:
+        out.write(f"({skipped} halo-cal-unstable row(s) excluded)\n")
+    return len(kept)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -202,7 +233,35 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="slowest-span list length")
     ap.add_argument("--perfetto", default=None, metavar="OUT",
                     help="also write Chrome/Perfetto trace-event JSON")
+    ap.add_argument("--attribution", action="store_true",
+                    help="render the measured-vs-modeled attribution "
+                         "table from the perf ledger instead of the "
+                         "span report")
+    ap.add_argument("--bank", action="store_true",
+                    help="join the trace against its perf-ledger row "
+                         "and bank one source:'attribution' row first")
+    ap.add_argument("--ledger", default=None,
+                    help="perf ledger path (default: YT_PERF_LEDGER "
+                         "or repo-root PERF_LEDGER.jsonl)")
     args = ap.parse_args(argv)
+
+    if args.bank:
+        from yask_tpu.obs.attribution import attribute_and_bank
+        row = attribute_and_bank(trace=("" if args.trace == "all"
+                                        else args.trace),
+                                 events_path=args.path,
+                                 ledger_path=args.ledger)
+        if row is None:
+            sys.stdout.write("attribution: nothing banked (empty "
+                             "trace or quarantined perf row)\n")
+        else:
+            sys.stdout.write(f"attribution: banked {row['key']!r} "
+                             f"trace={row['extra']['trace']}\n")
+    if args.attribution:
+        from yask_tpu.perflab.ledger import read_rows
+        n = attribution_report(read_rows(path=args.ledger),
+                               top=args.top)
+        return 0 if n else 1
 
     rows = pick_trace(read_spans(args.path or default_trace_path()),
                       args.trace)

@@ -58,13 +58,24 @@ Rules (see docs/checking.md for the catalog):
   silently drops its artifact out of the end-to-end correlation
   spine.  Out of scope in ``tests/`` (fixture writers); the tracer's
   own row writer is pragma'd — it IS the trace.
+* ``PHASE-SITE`` — a ``guarded_call``/``fault_point``/``maybe_corrupt``
+  site id that falls through ``phase_for_site``'s prefix table to the
+  default ``"guard"`` phase.  Guard spans are named after their sites,
+  so an unmapped site dumps its time into the catch-all bucket of
+  every obs_report/attribution breakdown instead of the phase it
+  belongs to; new device-facing sites must either match an existing
+  prefix or extend ``_SITE_PHASES`` (``yask_tpu/obs/tracer.py``) —
+  that is the drift this rule pins.  Lexically-resolvable ids only
+  (string literals and f-string prefixes); out of scope in ``tests/``
+  (throwaway unit-test sites).
 
 Detection of "an Expr value" is lexical (this is a linter, not a type
 checker): names ``expr``/``lhs``/``rhs``/``eq``, the ``*_expr``
 suffix, and attribute access ``.lhs`` / ``.rhs``.  Escape hatch: put
 ``# lint: <rule>-ok`` on the flagged line (rule tokens: ``expr-eq``,
 ``expr-key``, ``devices``, ``mesh``, ``compile-direct``,
-``bare-device-call``, ``ckpt-unguarded``, ``trace-id``).
+``bare-device-call``, ``ckpt-unguarded``, ``trace-id``,
+``phase-site``).
 
 Usage: ``python tools/repo_lint.py [paths...]`` — defaults to the
 repo root; exit 1 when anything fires.
@@ -77,6 +88,10 @@ import json
 import os
 import sys
 from typing import List, Optional
+
+# the PHASE-SITE rule imports the REAL phase table (drift check)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 SKIP_DIRS = {".git", ".perf_bisect", "__pycache__", ".claude",
              ".pytest_cache", "build"}
@@ -473,6 +488,67 @@ def _lint_trace_id(tree: ast.AST, relpath: str,
     return findings
 
 
+# ---- PHASE-SITE ----------------------------------------------------------
+#: calls whose first positional argument IS a site id
+_SITE_CALLS = {"fault_point", "maybe_corrupt"}
+
+
+def _phase_site_in_scope(relpath: str) -> bool:
+    """Everything but tests/ — unit tests mint throwaway site ids;
+    production sites must land in a real phase bucket."""
+    return not relpath.startswith("tests" + os.sep)
+
+
+def _site_literal(node: ast.AST) -> Optional[str]:
+    """The lexically resolvable site id: a string constant, or the
+    leading constant of an f-string (``phase_for_site`` matches on
+    prefixes, so the static head of ``f"suite.{name}"`` resolves the
+    same as the full id).  None = dynamic, not checkable here."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) \
+                and isinstance(first.value, str):
+            return first.value
+    return None
+
+
+def _lint_phase_sites(tree: ast.AST, relpath: str,
+                      lines: List[str]) -> List[dict]:
+    """Flag site ids that resolve to the default "guard" phase — the
+    prefix-table drift check (the REAL ``phase_for_site`` is imported,
+    so the rule and the runtime can never disagree)."""
+    from yask_tpu.obs.tracer import phase_for_site
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        site = None
+        if name in _SITE_CALLS and node.args:
+            site = _site_literal(node.args[0])
+        elif name == "guarded_call":
+            for kw in node.keywords:
+                if kw.arg == "site":
+                    site = _site_literal(kw.value)
+        if site is None or phase_for_site(site) != "guard":
+            continue
+        line = (lines[node.lineno - 1]
+                if node.lineno - 1 < len(lines) else "")
+        if "# lint: phase-site-ok" in line:
+            continue
+        findings.append({
+            "rule": "PHASE-SITE", "path": relpath, "line": node.lineno,
+            "message": f"site {site!r} falls through phase_for_site to "
+                       "the default 'guard' phase — its span time lands "
+                       "in the catch-all bucket of every breakdown; "
+                       "match an existing prefix or extend _SITE_PHASES "
+                       "(yask_tpu/obs/tracer.py), or pragma a "
+                       "deliberately unphased site"})
+    return findings
+
+
 def lint_file(path: str, root: str) -> List[dict]:
     relpath = os.path.relpath(path, root)
     with open(path, encoding="utf-8") as f:
@@ -490,6 +566,8 @@ def lint_file(path: str, root: str) -> List[dict]:
         findings.extend(_lint_device_calls(tree, relpath, lines))
     if _trace_rule_in_scope(relpath):
         findings.extend(_lint_trace_id(tree, relpath, lines))
+    if _phase_site_in_scope(relpath):
+        findings.extend(_lint_phase_sites(tree, relpath, lines))
     return findings
 
 

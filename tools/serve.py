@@ -276,6 +276,12 @@ class ServeFront:
     def op_metrics(self, msg):
         return {"ok": True, "metrics": self.server.metrics()}
 
+    def op_metrics_snapshot(self, msg):
+        # the fleet front's telemetry poll: the full registry snapshot
+        # (raw histogram windows included — the merge pools samples,
+        # it never averages percentiles) + occupancy/cache/journal/SLO
+        return {"ok": True, "snapshot": self.server.metrics_snapshot()}
+
     def op_cache_stats(self, msg):
         from yask_tpu.cache import cache_dir, stats
         return {"ok": True, "stats": stats(),

@@ -70,7 +70,11 @@ Usage::
 
     python tools/serve_fleet.py --workers 2 --cache-dir /tmp/ytcache
     # then speak the tools/serve.py JSON-lines protocol on stdio, or
-    # --port for TCP.  Extra op: {"op": "fleet_stats"}.
+    # --port for TCP.  Extra ops: {"op": "fleet_stats"} and
+    # {"op": "metrics_snapshot"} — the latter answers the merged
+    # fleet-wide telemetry snapshot (yask_tpu.obs.telemetry: histogram
+    # sample windows pooled and re-ranked, never averaged percentiles);
+    # the heartbeat loop banks the same snapshot every tick.
 """
 
 from __future__ import annotations
@@ -206,6 +210,9 @@ class ServeFleet:
         #: failover / retry — the auditable migration trail).
         self.journal = ServeJournal(os.path.join(
             self._jdir, "SERVE_JOURNAL.fleet.jsonl"))
+        #: last merged telemetry snapshot (banked by the heartbeat
+        #: loop / refreshed by ``op metrics_snapshot``).
+        self._telemetry: Optional[Dict] = None
         self.workers: List[FleetWorker] = []
         for i in range(max(1, int(n_workers))):
             self.workers.append(self._spawn_worker(i))
@@ -297,6 +304,13 @@ class ServeFleet:
                 self._failover(
                     w, cause=f"missed {w.hb_misses} heartbeats "
                              f"(deadline {fleet_hb_deadline()}s)")
+        # telemetry rides the same cadence: bank one merged fleet
+        # snapshot per tick (busy workers are skipped, not queued
+        # behind — a stale per-worker block beats a stalled heartbeat)
+        try:
+            self.collect_telemetry(block=False)
+        except Exception:  # noqa: BLE001 - telemetry must not take
+            pass           # supervision down
 
     def _ping_deadlined(self, w: FleetWorker) -> bool:
         """One heartbeat under the liveness deadline.  Caller holds
@@ -322,6 +336,43 @@ class ServeFleet:
         t.start()
         t.join(fleet_hb_deadline())
         return (not t.is_alive()) and "out" in result
+
+    def collect_telemetry(self, block: bool = True) -> Dict:
+        """Poll every worker's ``metrics_snapshot`` and merge into ONE
+        fleet snapshot (``yask_tpu.obs.telemetry.merge_snapshots`` —
+        histogram sample windows pooled and re-ranked; counters/gauges
+        summed; per-worker blocks kept).  ``block=False`` is the
+        heartbeat path: a busy worker is skipped rather than queued
+        behind its in-flight op, leaving its last-banked block out of
+        this tick.  The merged snapshot is banked on the fleet for
+        ``fleet_stats`` / ``op metrics_snapshot`` to answer from."""
+        import time
+        from yask_tpu.obs.telemetry import merge_snapshots
+        per: Dict[str, Dict] = {}
+        for w in list(self.workers):
+            wid = f"w{w.idx}"
+            if block:
+                try:
+                    out = w.call("metrics_snapshot")
+                except Exception as e:  # noqa: BLE001
+                    per[wid] = {"error": f"{type(e).__name__}: {e}"}
+                    continue
+            else:
+                if not w.lock.acquire(blocking=False):
+                    continue
+                try:
+                    out = w.client.call("metrics_snapshot")
+                except Exception:  # noqa: BLE001
+                    continue
+                finally:
+                    w.lock.release()
+            snap = dict(out.get("snapshot") or {})
+            snap["gen"] = w.gen
+            per[wid] = snap
+        merged = merge_snapshots(per, ts=time.time())
+        with self._lock:
+            self._telemetry = merged
+        return merged
 
     def _failover(self, w: FleetWorker, cause="") -> FleetWorker:
         """Replace a dead/unhealthy worker and fail its sessions over.
@@ -687,6 +738,7 @@ class ServeFleet:
 
     def op_fleet_stats(self, msg, emit=None):
         rows = []
+        slo_breaches = 0
         for w in self.workers:
             row = {"worker": w.idx, "journal": w.journal_path,
                    "sessions": sorted(w.sessions),
@@ -697,9 +749,29 @@ class ServeFleet:
                 row["cache_dir"] = cs.get("cache_dir")
             except Exception as e:  # noqa: BLE001
                 row["cache"] = {"error": f"{type(e).__name__}: {e}"}
+            # SLO surfacing: the worker's monitor state + journaled
+            # breach count (None slo = no YT_SLO_* knobs set)
+            try:
+                snap = w.call("metrics_snapshot").get("snapshot", {})
+                row["slo"] = snap.get("slo")
+                n = int((snap.get("journal") or {})
+                        .get("slo_breaches", 0))
+                row["slo_breaches"] = n
+                slo_breaches += n
+            except Exception as e:  # noqa: BLE001
+                row["slo"] = {"error": f"{type(e).__name__}: {e}"}
             rows.append(row)
-        return {"ok": True, "cache_dir": self.cache_dir,
-                "workers": rows}
+        out = {"ok": True, "cache_dir": self.cache_dir,
+               "slo_breaches": slo_breaches, "workers": rows}
+        with self._lock:
+            if self._telemetry is not None:
+                out["telemetry_ts"] = self._telemetry.get("ts")
+        return out
+
+    def op_metrics_snapshot(self, msg, emit=None):
+        """The merged fleet-wide telemetry snapshot (fresh poll; the
+        heartbeat loop banks the same shape every tick)."""
+        return {"ok": True, "telemetry": self.collect_telemetry()}
 
     def op_cache_stats(self, msg, emit=None):
         """Per-worker compile-cache counters (warm-start evidence)."""

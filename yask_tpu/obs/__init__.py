@@ -1,6 +1,8 @@
-"""Observability spine: span tracer + metrics registry + exporter
-hooks.  See ``docs/observability.md``; terminal/Perfetto rendering
-lives in ``tools/obs_report.py``."""
+"""Observability spine: span tracer + metrics registry + the
+interpretive layer over them (fleet telemetry merge, SLO burn-rate
+monitor, roofline attribution).  See ``docs/observability.md``;
+terminal/Perfetto rendering lives in ``tools/obs_report.py`` and
+Prometheus exposition in ``tools/obs_export.py``."""
 
 from yask_tpu.obs.tracer import (  # noqa: F401
     PHASES, TRACE_BASENAME, TRACE_SCHEMA, activate, compact_if_large,
@@ -12,4 +14,13 @@ from yask_tpu.obs.tracer import (  # noqa: F401
 from yask_tpu.obs.metrics import (  # noqa: F401
     REGISTRY, Counter, Gauge, Histogram, Registry, get_registry,
     percentile,
+)
+from yask_tpu.obs.telemetry import (  # noqa: F401
+    TELEMETRY_SCHEMA, merge_snapshots, prom_name, to_prometheus,
+)
+from yask_tpu.obs.slo import (  # noqa: F401
+    SLO_SCHEMA, SloMonitor, slo_enabled,
+)
+from yask_tpu.obs.attribution import (  # noqa: F401
+    ATTRIBUTION_SCHEMA, attribute, attribute_and_bank, join_model,
 )

@@ -96,6 +96,13 @@ class Histogram:
             xs = list(self._xs)
         return percentile(xs, q)
 
+    def samples(self) -> List[float]:
+        """The current window, oldest→newest.  Fleet aggregation merges
+        these raw samples across workers and re-ranks — percentiles are
+        never averaged (a mean of p99s is not a p99)."""
+        with self._lock:
+            return list(self._xs)
+
     @property
     def count(self) -> int:
         return self._count
@@ -156,6 +163,17 @@ class Registry:
                            for k, g in sorted(gauges.items())},
                 "histograms": {k: h.summary()
                                for k, h in sorted(hists.items())}}
+
+    def snapshot_full(self) -> Dict:
+        """Like :meth:`snapshot` but each histogram also carries its raw
+        sample window (``samples``) so an aggregator can merge windows
+        across processes and re-rank quantiles."""
+        snap = self.snapshot()
+        with self._lock:
+            hists = dict(self._hists)
+        for k, h in hists.items():
+            snap["histograms"][k]["samples"] = h.samples()
+        return snap
 
 
 #: the process-local default registry (import-cheap; producers that

@@ -268,7 +268,9 @@ _SITE_PHASES = (("ckpt.", "checkpoint"), ("cache.", "compile"),
                 ("fleet.", "front"), ("serve.flush", "front"),
                 ("state.", "dma"), ("dma", "dma"),
                 ("serve.", "compute"), ("run.", "compute"),
-                ("bench.", "compute"), ("session.", "compute"))
+                ("bench.", "compute"), ("session.", "compute"),
+                ("multihost.", "compute"), ("pipeline.", "compute"),
+                ("suite.", "compute"), ("watch.", "front"))
 
 
 def phase_for_site(site: str) -> str:

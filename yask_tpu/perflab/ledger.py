@@ -41,7 +41,8 @@ LEDGER_BASENAME = "PERF_LEDGER.jsonl"
 #: who measured the row; new producers register here so query tooling
 #: can enumerate them.
 KNOWN_SOURCES = ("bench", "suite", "harness", "tpu_session", "multichip",
-                 "bisect", "perfcheck", "test", "bench_seed")
+                 "bisect", "perfcheck", "test", "bench_seed",
+                 "attribution")
 
 _REQUIRED = ("v", "key", "value", "unit", "platform", "source",
              "measured_at", "provenance")

@@ -56,6 +56,21 @@ def ctx_roofline(ctx, env, rate_gpts: float) -> Dict:
                     ndev=env.get_num_ranks())
 
 
+def modeled_compute_secs(measured_secs: float,
+                         roofline_frac: Optional[float]
+                         ) -> Optional[float]:
+    """The attribution join's modeled compute time: ``roofline_frac``
+    is achieved/roofline rate, so a run at exactly the model's HBM
+    roofline would have finished the same work in ``measured × frac``
+    seconds.  None when the peak (and hence the fraction) is unknown —
+    the attribution row then carries measured time only.  Lives here so
+    measured-vs-modeled comparisons share the ONE roofline definition
+    with every other producer."""
+    if roofline_frac is None:
+        return None
+    return float(measured_secs) * float(roofline_frac)
+
+
 def format_roofline(roof: Dict) -> str:
     """The harness' human-readable lines for one roofline dict (the
     log keys ``tools/log_to_csv.py`` scrapes)."""

@@ -305,6 +305,45 @@ def check_row(key: str, value: float, unit: str, platform: str,
     return verdict
 
 
+#: absolute phase-share drift (in share points, 0..1) that flags an
+#: attribution row against the trailing clean median of its key — a
+#: phase quietly growing from 10% to 30% of the run is exactly the
+#: "where did the time go" regression the span trace exists to catch.
+ATTRIBUTION_SHARE_TOL = 0.15
+
+
+def check_attribution(shares: Dict[str, float], history: List[Dict],
+                      tol: float = ATTRIBUTION_SHARE_TOL,
+                      window: int = 5) -> Dict:
+    """Guard an attribution row's per-phase SHARES against the trailing
+    clean median of prior ``source: "attribution"`` rows for the same
+    key.  Shares are compared absolutely (share points), not
+    relatively — a 1%→3% phase tripling is noise, a 10%→30% one is a
+    drift.  Verdict statuses mirror :func:`check_row`:
+    ``no_history`` / ``ok`` / ``drift`` (with the offending phases and
+    their medians recorded in the verdict)."""
+    clean = [r for r in history if is_clean(r)][-window:]
+    if not clean:
+        return {"status": "no_history", "rule": "attribution-share-drift"}
+    meds: Dict[str, float] = {}
+    for ph in shares:
+        vals = sorted(
+            float((r.get("extra", {}).get("shares") or {}).get(ph, 0.0))
+            for r in clean)
+        meds[ph] = vals[len(vals) // 2]
+    drifted = {ph: {"share": round(s, 4), "median": round(meds[ph], 4)}
+               for ph, s in shares.items()
+               if abs(s - meds[ph]) > tol}
+    verdict: Dict = {"rule": "attribution-share-drift", "tol": tol,
+                     "window": len(clean)}
+    if drifted:
+        verdict["status"] = "drift"
+        verdict["drifted"] = drifted
+    else:
+        verdict["status"] = "ok"
+    return verdict
+
+
 def guard_and_append(key: str, value: float, unit: str, platform: str,
                      source: str, provenance: Dict,
                      rules: Optional[List[GuardRule]] = None,

@@ -48,7 +48,11 @@ SERVE_EVENTS = ("received", "batched", "ok", "anomaly", "rejected",
                 # id, snapshot step, replayed step range), retry = an
                 # in-flight op re-issued under its idempotency key,
                 # snapshot = a checkpoint banked for a session.
-                "worker_dead", "failover", "retry", "snapshot")
+                "worker_dead", "failover", "retry", "snapshot",
+                # slo_breach = the LOG-ONLY SLO monitor saw every
+                # burn-rate window above threshold (detail: signal,
+                # budget, per-window burn; trace_id = worst offender).
+                "slo_breach")
 
 
 def _repo_root() -> str:

@@ -52,6 +52,7 @@ relay errors classify; a hard in-C stall needs the subprocess front).
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -158,6 +159,10 @@ class BatchScheduler:
         self._pending: List[_Pending] = []
         self._cond = threading.Condition()
         self._breaker = Breaker()
+        # LOG-ONLY SLO monitor (None unless a YT_SLO_* knob is set —
+        # the unconfigured path must cost nothing and write nothing)
+        from yask_tpu.obs.slo import SloMonitor
+        self._slo = SloMonitor.from_env()
         self._shutdown = False
         self._next_rid = 0
         self._samples: List[Dict] = []
@@ -215,6 +220,16 @@ class BatchScheduler:
     def samples(self) -> List[Dict]:
         with self._lock:
             return list(self._samples)
+
+    def slo_summary(self) -> Optional[Dict]:
+        """The SLO monitor's burn-rate state (None when no YT_SLO_*
+        knob configured it)."""
+        if self._slo is None:
+            return None
+        try:
+            return self._slo.summary()
+        except Exception:  # noqa: BLE001 - surfacing must never raise
+            return None
 
     def session_ctx(self, sid: str):
         """Contextmanager: the session's prepared context with ITS
@@ -327,9 +342,43 @@ class BatchScheduler:
         self._journal.record(p.rid, p.req.session, "rejected",
                              trace_id=p.trace, error=why[:200])
         self._obs.counter("serve.requests.rejected").inc()
+        self._slo_feed(p, p.req.session, ok=False)
         return ServeResponse(rid=p.rid, session=p.req.session,
                              status="rejected", error=why,
                              trace=p.trace)
+
+    def _slo_feed(self, p: _Pending, sid: str, *, ok: bool,
+                  quarantined: bool = False,
+                  total_ms: Optional[float] = None,
+                  occupancy: Optional[float] = None) -> None:
+        """Feed the SLO monitor one released/rejected request and
+        journal any NEW breach as an ``slo_breach`` row (schema
+        ``yask_tpu.slo/1``) joined to the worst offender's trace id.
+        LOG-ONLY by contract: breaches print and journal; nothing is
+        blocked, and a monitor bug must never break serving."""
+        if self._slo is None:
+            return
+        try:
+            self._slo.record(ok=ok, quarantined=quarantined,
+                             preempted=bool(p.preempts),
+                             total_ms=total_ms, occupancy=occupancy,
+                             trace=p.trace)
+            for br in self._slo.evaluate():
+                self._journal.record(
+                    p.rid, sid, "slo_breach",
+                    trace_id=br.get("trace") or p.trace,
+                    slo_v=br["v"], signal=br["signal"],
+                    budget=br["budget"], threshold=br["threshold"],
+                    windows=br["windows"])
+                # stderr: a worker's stdout is the JSON-lines wire
+                print(f"[serve] SLO breach: {br['signal']} burning "
+                      f"past {br['threshold']}x budget {br['budget']} "
+                      f"in all windows (trace "
+                      f"{br.get('trace') or p.trace or '-'}) "
+                      "— LOG-ONLY, serving continues",
+                      file=sys.stderr)
+        except Exception:  # noqa: BLE001 - observability must never
+            pass           # take down the serving loop
 
     def _execute(self, batch: List[_Pending]) -> None:
         """One scheduling turn for a collected batch: journal the
@@ -727,4 +776,8 @@ class BatchScheduler:
             (queue_secs + run_secs) * 1e3)
         reg.histogram("serve.batch_occupancy").observe(batch)
         reg.gauge("serve.queue_depth").set(self.queue_depth())
+        self._slo_feed(p, sess.sid, ok=(resp.status == "ok"),
+                       quarantined=(resp.status == "anomaly"),
+                       total_ms=(queue_secs + run_secs) * 1e3,
+                       occupancy=batch)
         return resp

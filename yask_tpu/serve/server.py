@@ -315,6 +315,35 @@ class StencilServer:
             "registry": self.obs.snapshot(),
         }
 
+    def metrics_snapshot(self) -> Dict:
+        """The per-worker telemetry unit the fleet front aggregates
+        (``op metrics_snapshot``): the registry snapshot WITH raw
+        histogram sample windows (so the aggregator can merge windows
+        and re-rank quantiles — never average percentiles), plus
+        cache/journal occupancy counters and the SLO monitor's state
+        (None unless YT_SLO_* configured one)."""
+        from yask_tpu.cache import compile_cache
+        snap = self.obs.snapshot_full()
+        snap["v"] = "yask_tpu.telemetry/1"
+        snap["cache"] = compile_cache.stats()
+        jrows = self.journal.rows()
+        snap["journal"] = {
+            "rows": len(jrows),
+            "inflight": sum(1 for r in jrows
+                            if r.get("event") == "received")
+            - sum(1 for r in jrows
+                  if r.get("event") in ("ok", "anomaly", "rejected")),
+            "slo_breaches": sum(1 for r in jrows
+                                if r.get("event") == "slo_breach"),
+        }
+        snap["occupancy"] = {
+            "queue_depth": self.scheduler.queue_depth(),
+            "sessions": len(self.registry.sessions()),
+            "profiles": len(self.registry.profiles()),
+        }
+        snap["slo"] = self.scheduler.slo_summary()
+        return snap
+
     def flush_metrics(self) -> List[Dict]:
         """Append the serving metrics to PERF_LEDGER.jsonl (source
         ``serve``; latency/occupancy units are outside the sentinel's

@@ -81,11 +81,16 @@ def logs_to_csv(paths: List[str], out=None) -> None:
         w.writerow(r)
 
 
-#: Ledger columns, identity → value → verdict → roofline → provenance.
+#: Ledger columns, identity → value → verdict → roofline →
+#: attribution → provenance.  ``trace_id`` joins back to the span
+#: file; ``attr_shares`` / ``attr_root_secs`` flatten the
+#: source:"attribution" rows (empty on every other source).
 LEDGER_COLS = [
     "key", "value", "unit", "platform", "source", "measured_at",
+    "trace_id",
     "guard_status", "guard_baseline", "guard_remeasured",
     "roofline_frac", "hbm_gbps", "hbm_bytes_pp",
+    "attr_shares", "attr_root_secs",
     "git_sha", "load1", "ncpu", "calib_gpts", "cpu_model",
     "device_kind", "jax", "env_fp",
 ]
@@ -99,14 +104,24 @@ def ledger_to_csv(path: str = "", out=None) -> int:
     rows = read_rows(path or default_ledger_path())
     w = csv.DictWriter(out, fieldnames=LEDGER_COLS, extrasaction="ignore")
     w.writeheader()
+    import json
+
     for r in rows:
         prov = r.get("provenance", {})
         guard = r.get("guard", {})
         roof = r.get("roofline", {})
+        extra = r.get("extra", {})
         load = prov.get("loadavg") or [None]
+        shares = (extra.get("shares")
+                  if r.get("source") == "attribution" else None)
         w.writerow({
             **{k: r.get(k) for k in ("key", "value", "unit", "platform",
-                                     "source", "measured_at")},
+                                     "source", "measured_at",
+                                     "trace_id")},
+            "attr_shares": (json.dumps(shares, sort_keys=True)
+                            if shares else None),
+            "attr_root_secs": (extra.get("root_secs")
+                               if shares else None),
             "guard_status": guard.get("status"),
             "guard_baseline": guard.get("baseline"),
             "guard_remeasured": guard.get("remeasured"),
