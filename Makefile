@@ -8,7 +8,7 @@ TEST_ENV ?= PALLAS_AXON_POOL_IPS=
 .PHONY: all native capi test test-fast scratch-tests boundary-tests \
         stages-tests mode-tests bench perfcheck faultcheck commcheck \
         cachecheck servecheck obscheck telemetrycheck examples clean \
-        list-stencils lint check
+        list-stencils lint check conformance conformance-quick
 
 all: native test
 
@@ -94,10 +94,22 @@ telemetrycheck: lint
 # static checker over the flagship configs: Mosaic legality, VMEM
 # feasibility (incl. the round-3 spill-OOM class), races, explain.
 # See docs/checking.md; nonzero exit on any error-severity finding.
-check: cachecheck servecheck obscheck telemetrycheck
+check: cachecheck servecheck obscheck telemetrycheck conformance-quick
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker \
 		-stencil iso3dfd -radius 8 -g 256 -mode pallas -wf_steps 2
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker -all_stencils
+
+# differential checker-soundness harness (docs/checking.md): random
+# solution+config per seed, static verdict vs an actual pallas-vs-jit
+# run on the interpret host; nonzero exit on any unsound/overstrict
+# disagreement (minimized repro JSONs land under tools/logs/).
+# `check` carries the 16-seed quick subset; the 200-seed sweep is the
+# pre-merge / nightly gate.
+conformance:
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) tools/checker_conformance.py
+
+conformance-quick: lint
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) tools/checker_conformance.py --quick
 
 # quick bench rows through the regression sentinel: nonzero exit on an
 # unexplained breach (see tools/perfcheck.py; ledger = PERF_LEDGER.jsonl)

@@ -1,0 +1,187 @@
+"""Rule-registry contract tests (round 21).
+
+Rule ids are a public, stable contract; these tests pin the three ways
+it can silently rot: an undeclared id shipping from a pass, a declared
+id losing its ``docs/checking.md`` catalog row, and the ``--json``
+report drifting from its schema.
+"""
+
+import ast
+import json
+import os
+
+import pytest
+
+from yask_tpu import yk_factory
+from yask_tpu.checker import SCHEMA, run_checks
+from yask_tpu.checker.rules import (CORE, PLAN_REASON_CODES, all_rules,
+                                    flat_rules)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKER_DIR = os.path.join(REPO, "yask_tpu", "checker")
+
+
+@pytest.fixture(scope="module")
+def env():
+    return yk_factory().new_env()
+
+
+def _checker_sources():
+    for fn in sorted(os.listdir(CHECKER_DIR)):
+        if not fn.endswith(".py"):
+            continue
+        path = os.path.join(CHECKER_DIR, fn)
+        with open(path, encoding="utf-8") as f:
+            yield fn, ast.parse(f.read(), filename=path)
+
+
+def _add_rule_literals(tree):
+    """First-arg string literals of every ``report.add(...)`` /
+    ``<x>.add(...)`` call."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add" and node.args):
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                yield a0.value
+
+
+# ---------------------------------------------------------------- ids
+def test_rule_ids_unique_across_passes():
+    """No id belongs to two passes — except the declared CORE pair,
+    which the entry point and any pass may share."""
+    seen = {}
+    for pass_name, ids in all_rules().items():
+        assert len(ids) == len(set(ids)), f"duplicate id inside {pass_name}"
+        for rid in ids:
+            if rid in CORE:
+                continue
+            assert rid not in seen, (
+                f"rule {rid} declared by both {seen[rid]} and {pass_name}")
+            seen[rid] = pass_name
+
+
+def test_rule_id_style():
+    for rid in flat_rules():
+        assert rid.upper() == rid and " " not in rid, rid
+        assert all(c.isalnum() or c == "-" for c in rid), rid
+
+
+def test_every_add_site_is_declared():
+    """AST scan: a literal rule id at any ``report.add`` site in the
+    checker package must be declared — a typo'd id cannot ship."""
+    declared = flat_rules()
+    undeclared = []
+    for fn, tree in _checker_sources():
+        for rid in _add_rule_literals(tree):
+            if rid not in declared:
+                undeclared.append((fn, rid))
+    assert not undeclared, f"undeclared rule ids at add sites: {undeclared}"
+
+
+def test_dynamic_rule_families_declared():
+    """The three dynamically-built id families are covered by the
+    registry: the vmem plan-error classifier's return set, the races
+    analysis-failure pair, and every planner reason code mapped
+    through the explain pass."""
+    declared = flat_rules()
+
+    # vmem._classify_plan_error: every `return "X"` literal
+    with open(os.path.join(CHECKER_DIR, "vmem.py"), encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    fn = next(n for n in ast.walk(tree)
+              if isinstance(n, ast.FunctionDef)
+              and n.name == "_classify_plan_error")
+    returns = {n.value.value for n in ast.walk(fn)
+               if isinstance(n, ast.Return)
+               and isinstance(n.value, ast.Constant)}
+    assert returns, "classifier grew no literal returns?"
+    assert returns <= declared, returns - declared
+
+    assert {"RACE-CYCLE", "ANALYSIS-FAILED"} <= declared
+
+    from yask_tpu.checker.explain import _rule_of
+    for code in PLAN_REASON_CODES:
+        assert _rule_of(code) in declared
+
+
+def test_planner_reason_codes_complete():
+    """Planner↔registry drift check: every ``{"code": "..."}`` literal
+    ``build_pallas_chunk`` records must be a declared reason code, so
+    a new planner decision cannot ship without its EXPLAIN rule."""
+    path = os.path.join(REPO, "yask_tpu", "ops", "pallas_stencil.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    recorded = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for k, v in zip(node.keys, node.values):
+            if (isinstance(k, ast.Constant) and k.value == "code"
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                recorded.add(v.value)
+    assert recorded, "no reason codes found in the planner?"
+    missing = recorded - set(PLAN_REASON_CODES)
+    assert not missing, (
+        f"planner records reason codes with no declared EXPLAIN rule: "
+        f"{sorted(missing)} — add them to rules.PLAN_REASON_CODES and "
+        "docs/checking.md")
+
+
+# ---------------------------------------------------------------- docs
+def test_catalog_documents_every_rule():
+    """Every declared rule id (and every planner reason code) appears
+    in docs/checking.md — the catalog cannot silently fall behind."""
+    with open(os.path.join(REPO, "docs", "checking.md"),
+              encoding="utf-8") as f:
+        doc = f.read()
+    missing = [rid for rid in sorted(flat_rules())
+               if not rid.startswith("EXPLAIN-") and rid not in doc]
+    # EXPLAIN-* rules are documented by their reason CODE rows
+    missing += [c for c in PLAN_REASON_CODES if c not in doc]
+    assert not missing, f"docs/checking.md missing catalog rows: {missing}"
+
+
+# ------------------------------------------------------------- schema
+def _report(env, **settings):
+    ctx = yk_factory().new_solution(env, stencil="iso3dfd", radius=4)
+    ctx.apply_command_line_options("-g 32")
+    o = ctx.get_settings()
+    o.mode = settings.pop("mode", "pallas")
+    for k, v in settings.items():
+        setattr(o, k, v)
+    return run_checks(ctx)
+
+
+def test_json_round_trip_schema(env):
+    """``to_json`` → dumps → loads reproduces a valid
+    ``yask_tpu.checker/1`` document: required keys, declared rules,
+    valid severities, summary counts that add up."""
+    report = _report(env, wf_steps=2)
+    blob = json.loads(json.dumps(report.to_json()))
+    assert blob["schema"] == SCHEMA == "yask_tpu.checker/1"
+    for key in ("config", "passes", "diagnostics", "summary"):
+        assert key in blob, key
+    assert blob["config"]["backend"]      # the capability entry name
+    assert set(blob["passes"]) and isinstance(blob["passes"], list)
+
+    declared = flat_rules()
+    counts = {"error": 0, "warn": 0, "info": 0}
+    assert blob["diagnostics"], "expected at least the info decisions"
+    for d in blob["diagnostics"]:
+        assert d["rule"] in declared, d["rule"]
+        assert d["severity"] in counts, d["severity"]
+        assert d["message"]
+        counts[d["severity"]] += 1
+    assert blob["summary"] == counts
+
+
+def test_json_round_trip_error_case(env):
+    """An error-carrying report round-trips too (deep-ring spill class:
+    big grid, forced big blocks, tiny budget)."""
+    report = _report(env, wf_steps=2, vmem_budget_mb=1)
+    blob = json.loads(json.dumps(report.to_json()))
+    declared = flat_rules()
+    assert all(d["rule"] in declared for d in blob["diagnostics"])

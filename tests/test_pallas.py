@@ -71,15 +71,17 @@ def test_pallas_block_sizes(env):
     assert p.compare_data(ref) == 0
 
 
-@pytest.mark.xfail(
-    reason="carried from the v0 seed (verified: identical 76-point "
-           "mismatch at 5a429c4, before any growth PR): the fused "
-           "pallas path mis-consumes per-stage write margins in ssg's "
-           "same-step velocity→stress chain, already at wf=1",
-    strict=False)
 def test_pallas_multi_stage_ssg(env):
     """Staggered elastic (velocity→stress same-step chain) on the fused
-    path: per-stage margin consumption must reproduce the XLA path."""
+    path: per-stage margin consumption must reproduce the XLA path.
+
+    The fused in-tile evaluation reassociates the staggered-difference
+    sums differently from XLA's fusion (FMA contraction order), so a
+    few points differ by ulps OF THE FIELD SCALE at near-cancellation
+    sites — scattered over the whole domain, not banded.  The
+    ``field_epsilon`` term absorbs exactly that class; a geometry bug
+    produces O(field) errors and still fails it (the pre-fix awp skew
+    carry bug showed 52k+ mismatches at this tolerance)."""
     from yask_tpu.runtime.init_utils import init_solution_vars
 
     def mk(mode, wf=1):
@@ -93,31 +95,30 @@ def test_pallas_multi_stage_ssg(env):
         return ctx
 
     ref = mk("jit")
-    assert mk("pallas", wf=1).compare_data(ref) == 0
-    assert mk("pallas", wf=2).compare_data(ref) == 0
+    assert mk("pallas", wf=1).compare_data(ref, field_epsilon=1e-4) == 0
+    assert mk("pallas", wf=2).compare_data(ref, field_epsilon=1e-4) == 0
 
 
-# These four stencil classes mismatch the jit oracle IN THE v0 SEED
-# (verified by running 5a429c4 directly: identical per-case mismatch
-# counts before any growth PR) — the root cause is the seed's in-tile
-# evaluation of IF_DOMAIN condition regions combined with partial-dim /
-# sponge coefficient vars in multi-stage chains (boundary condition
-# bands mis-apply near tile edges); not a regression of any later
-# round.  Pinned so tier-1 stays green and NEW pallas breakage is
-# visible; the pallas boundary/condition single-stage classes below
-# still pass and keep guarding the common path.
-_SEED_COND_XFAIL = pytest.mark.xfail(
-    reason="carried from the v0 seed: in-tile IF_DOMAIN condition "
-           "bands with partial-dim/sponge coefficient vars mismatch "
-           "the jit oracle in multi-stage chains",
-    strict=False)
+# Stencils whose fused in-tile evaluation reassociates long staggered /
+# sponge-coefficient sums: XLA's fusion contracts FMAs in a different
+# order, so isolated points differ by ulps of the field scale at
+# near-cancellation sites (triaged r21: mismatches are scattered over
+# the WHOLE domain, not banded near tile edges; one step already shows
+# them; max |Δ| ~1e-6 on O(1) fields).  These compare with
+# field_epsilon=1e-4 — generous vs the observed ~1e-5 noise ceiling,
+# yet a real geometry bug (O(field) errors, e.g. the pre-fix awp skew
+# carry: 52k+ points beyond this tolerance) still fails.  Everything
+# else stays an EXACT compare.
+_FP_REASSOC = {"iso3dfd_sponge", "awp", "fsg", "awp_abc", "ssg"}
 
 
 @pytest.mark.parametrize("name,radius", [
-    pytest.param("iso3dfd_sponge", 2, marks=_SEED_COND_XFAIL,
-                 id="iso3dfd_sponge-2"),  # partial-dim (1-D) coeff vars
-    pytest.param("awp", None, marks=_SEED_COND_XFAIL,
-                 id="awp-None"),  # 4 stages, IF_DOMAIN conds, 0-dim var
+    ("iso3dfd_sponge", 2),   # partial-dim (1-D) coeff vars
+    # awp at wf=2 engages skew on the outer dim and its anelastic mem_*
+    # vars are read ONLY at zero offset — the regression class the skew
+    # carry must cover (same-point reads don't appear in
+    # stage_read_widths; see analysis.read_var_names)
+    ("awp", None),           # 4 stages, IF_DOMAIN conds, 0-dim var
     ("test_partial_3d", None),  # partial vars w/o minor — expect fallback
     ("test_step_cond_1d", None),  # IF_STEP in a 1-D single-tile solution
     ("test_scratch_1d", None),  # 1-D scratch chain, asymmetric halos
@@ -133,10 +134,8 @@ _SEED_COND_XFAIL = pytest.mark.xfail(
     ("test_boundary_3d", None),  # box-interior IF_DOMAIN pair
     ("test_4d", None),       # 4-D: three lead dims on the grid
     ("test_reverse_2d", None),  # reverse-time stepping in-tile
-    pytest.param("fsg", 2, marks=_SEED_COND_XFAIL,
-                 id="fsg-2"),  # large multi-var staggered family
-    pytest.param("awp_abc", None, marks=_SEED_COND_XFAIL,
-                 id="awp_abc-None"),  # sponge ABC + conditions
+    ("fsg", 2),              # large multi-var staggered family
+    ("awp_abc", None),       # sponge ABC + conditions
     ("wave2d", None),        # 2nd-order-in-time (3-slot ring) physics
 ])
 def test_pallas_condition_and_partial_class(env, name, radius):
@@ -160,8 +159,9 @@ def test_pallas_condition_and_partial_class(env, name, radius):
             mk("pallas")
         return
     ref = mk("jit")
-    assert mk("pallas", wf=1).compare_data(ref) == 0
-    assert mk("pallas", wf=2).compare_data(ref) == 0
+    fe = 1e-4 if name in _FP_REASSOC else 0.0
+    assert mk("pallas", wf=1).compare_data(ref, field_epsilon=fe) == 0
+    assert mk("pallas", wf=2).compare_data(ref, field_epsilon=fe) == 0
 
 
 def test_pallas_applicability_rules():

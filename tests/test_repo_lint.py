@@ -402,6 +402,72 @@ def test_phase_site_pragma_and_tests_scope(tmp_path):
                      name=os.path.join("tests", "t.py")) == []
 
 
+def lint_scoped(tmp_path, src, name):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return repo_lint.lint_file(str(p), str(tmp_path))
+
+
+CAP_SCOPE = os.path.join("yask_tpu", "compiler", "lowering.py")
+
+
+def test_cap_const_fires_on_each_literal_class(tmp_path):
+    # all four re-baked-constant shapes: raw lane 128, sublane
+    # alignment arithmetic, constant-MiB byte value, itemsize→sublane
+    # dict map
+    fs = lint_scoped(tmp_path, """\
+        def geom(total, off, itemsize):
+            lanes = 128
+            ok = off % 8 == 0 and total // 16 > 1
+            budget = 64 * 2 ** 20
+            folds = {4: 8, 2: 16, 1: 32}
+            return lanes, ok, budget, folds[itemsize]
+    """, CAP_SCOPE)
+    assert sorted(fired(fs)) == ["CAP-CONST"] * 5
+    assert all("capability" in f["message"] for f in fs)
+
+
+def test_cap_const_scope_is_the_drift_perimeter(tmp_path):
+    # same source: flagged in the planner/checker perimeter, legal in
+    # the capability table itself (the sanctioned home) and anywhere
+    # outside the single-source-of-truth modules
+    src = """\
+        def f(off):
+            return off % 8 == 0 and 128
+    """
+    for name in (CAP_SCOPE,
+                 os.path.join("yask_tpu", "ops", "tile_planner.py"),
+                 os.path.join("yask_tpu", "checker", "vmem.py")):
+        assert "CAP-CONST" in fired(lint_scoped(tmp_path, src, name)), name
+    for name in (os.path.join("yask_tpu", "backend", "capability.py"),
+                 os.path.join("yask_tpu", "runtime", "context.py"),
+                 "tools/t.py"):
+        assert "CAP-CONST" not in fired(lint_scoped(tmp_path, src, name)), \
+            name
+
+
+def test_cap_const_dict_keys_and_plain_ints_exempt(tmp_path):
+    # itemsize→X maps KEY on byte sizes; a bare 8 outside alignment
+    # arithmetic is a loop bound, not a layout fact
+    fs = lint_scoped(tmp_path, """\
+        def f(xs):
+            table = {128: "lane", 8: "sub"}
+            n = 8
+            halo = 16 + n
+            return table, halo, xs[:8]
+    """, CAP_SCOPE)
+    assert fs == []
+
+
+def test_cap_const_pragma(tmp_path):
+    fs = lint_scoped(tmp_path, """\
+        def f(n):
+            return n * 2 ** 20  # lint: cap-const-ok
+    """, CAP_SCOPE)
+    assert fs == []
+
+
 def test_repo_is_clean():
     findings = repo_lint.run_lint([ROOT], root=ROOT)
     assert findings == [], findings

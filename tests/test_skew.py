@@ -40,12 +40,14 @@ def make(env, mode, name, r=8, g=48, wf=1, block=None, skew=None,
     return ctx
 
 
-def _compare(env, name, r=8, g=48, wf=2, block=None, steps=6):
+def _compare(env, name, r=8, g=48, wf=2, block=None, steps=6,
+             field_epsilon=0.0):
     ref = make(env, "jit", name, r=r, g=g)
     ref.run_solution(0, steps - 1)
     p = make(env, "pallas", name, r=r, g=g, wf=wf, block=block)
     p.run_solution(0, steps - 1)
-    return p.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4)
+    return p.compare_data(ref, epsilon=1e-3, abs_epsilon=1e-4,
+                          field_epsilon=field_epsilon)
 
 
 def test_skew_engages_for_aligned_radius(env):
@@ -92,29 +94,21 @@ def test_skew_engages_for_unaligned_radius(env):
                                        rtol=2e-5, atol=1e-6)
 
 
-# The two truly-misaligned cases mismatch the jit oracle IN THE v0
-# SEED (verified at 5a429c4: identical 3/4-point mismatches before any
-# growth PR): when the per-level write-window shift (lvl-1)·r is not a
-# sublane-tile multiple, the seed's carry-strip rounding drops a
-# boundary band of a few points.  r=1 (shift rounds to 0, widened
-# window) is exact and stays a hard assert.
-_SEED_MISALIGN_XFAIL = pytest.mark.xfail(
-    reason="carried from the v0 seed: sublane-misaligned skew write "
-           "windows (shift (lvl-1)*r % 8 != 0) round the carry strip "
-           "and drop a boundary band vs the jit oracle",
-    strict=False)
-
-
-@pytest.mark.parametrize("r,wf,block", [
-    (1, 2, {"x": 16, "y": 16}),    # shift 1: rounds to 0, widened window
-    pytest.param(2, 3, {"x": 16, "y": 16}, marks=_SEED_MISALIGN_XFAIL,
-                 id="2-3-block1"),  # shifts 2,4: both misaligned
-    pytest.param(4, 2, {"x": 16, "y": 16}, marks=_SEED_MISALIGN_XFAIL,
-                 id="4-2-block2"),  # shift 4: half a sublane tile
+# The two truly-misaligned cases (shift (lvl-1)·r % 8 != 0) take the
+# widened-window path; its different reduction grouping leaves a
+# handful of field-ulp differences vs the jit oracle (triaged r21:
+# 3/4 isolated points, |Δ| at the f32 ulp of the field scale — not a
+# dropped band; a carry-geometry bug shows O(field) banded errors and
+# fails field_epsilon=1e-4 by thousands of points).  r=1 (shift rounds
+# to 0) is exact and stays a hard zero-tolerance assert.
+@pytest.mark.parametrize("r,wf,block,fe", [
+    (1, 2, {"x": 16, "y": 16}, 0.0),  # shift 1: rounds to 0, exact
+    (2, 3, {"x": 16, "y": 16}, 1e-4),  # shifts 2,4: both misaligned
+    (4, 2, {"x": 16, "y": 16}, 1e-4),  # shift 4: half a sublane tile
 ])
-def test_skew_misaligned_radius_matches_jit(env, r, wf, block):
+def test_skew_misaligned_radius_matches_jit(env, r, wf, block, fe):
     assert _compare(env, "iso3dfd", r=r, g=32, wf=wf, block=block,
-                    steps=wf * 2) == 0
+                    steps=wf * 2, field_epsilon=fe) == 0
 
 
 def test_skew_misaligned_radius_cube_r1(env):
@@ -138,18 +132,42 @@ def test_skew_sponge_conditions(env):
                     block={"x": 24, "y": 24}) == 0
 
 
-@pytest.mark.xfail(
-    reason="carried from the v0 seed (identical 4-point mismatch at "
-           "5a429c4): ssg's staged chain mis-consumes per-stage "
-           "margins inside skewed sub-steps — same root cause as "
-           "test_pallas_multi_stage_ssg, surfacing as a cross-tile "
-           "strip misalignment",
-    strict=False)
 def test_skew_multi_stage(env):
     """ssg's staged chain: stage margins consume within each skewed
-    sub-step; cross-tile strips must still line up."""
+    sub-step; cross-tile strips must still line up.  The fused chain
+    reassociates the staggered sums (see test_pallas_multi_stage_ssg),
+    so a few field-ulp points ride field_epsilon; strip misalignment
+    would fail it by orders of magnitude."""
     assert _compare(env, "ssg", r=8, g=32, wf=2,
-                    block={"x": 16, "y": 16}, steps=4) == 0
+                    block={"x": 16, "y": 16}, steps=4,
+                    field_epsilon=1e-4) == 0
+
+
+def test_skew_same_point_carry(env):
+    """Regression (r21): awp's anelastic mem_* vars are written AND
+    read only at zero spatial offset, so they never appear in
+    stage_read_widths — but a later sub-step still consumes the slid
+    strip from the neighboring tile, so they MUST ride the skew carry
+    (analysis.read_var_names).  Pre-fix this corrupted a radius-wide
+    band (~9.5k points/step beyond field tolerance); elastic variants
+    (no mem chain) never showed it."""
+    from yask_tpu.runtime.init_utils import init_solution_vars
+
+    def mk(mode, wf=1):
+        ctx = yk_factory().new_solution(env, stencil="awp")
+        ctx.apply_command_line_options("-g 20")
+        ctx.get_settings().mode = mode
+        ctx.get_settings().wf_steps = wf
+        ctx.prepare_solution()
+        init_solution_vars(ctx)
+        ctx.run_solution(0, 3)
+        return ctx
+
+    ref = mk("jit")
+    p = mk("pallas", wf=2)
+    tiling = list(p._pallas_tiling.values())[0]
+    assert tiling["skew"] is True      # the trigger: outer-dim skew
+    assert p.compare_data(ref, field_epsilon=1e-4) == 0
 
 
 def test_skew_scratch_chain(env):

@@ -69,13 +69,27 @@ Rules (see docs/checking.md for the catalog):
   (string literals and f-string prefixes); out of scope in ``tests/``
   (throwaway unit-test sites).
 
+* ``CAP-CONST`` — a raw backend-legality literal (lane-tile ``128``,
+  a sublane alignment ``% 8`` / ``// 8`` (or 16/32), a
+  sublane-by-itemsize dict map, or a constant-MiB VMEM byte value
+  ``N * 2**20``) re-appearing in the modules that must read those
+  facts from the backend capability table
+  (``yask_tpu/backend/capability.py``): VarGeom/lowering, the tile
+  planner, the pallas build, and the checker passes.  A re-baked
+  constant is exactly the drift the table exists to kill — the static
+  checker would keep modeling a rule the runtime no longer enforces
+  (or vice versa).  Go through ``get_capability()`` /
+  ``tpu_tile_dims`` / ``sublane_count`` / ``vmem_limit_bytes``
+  instead.  Dict KEYS are exempt (itemsize→dtype maps key on element
+  bytes, which is data, not a layout fact).
+
 Detection of "an Expr value" is lexical (this is a linter, not a type
 checker): names ``expr``/``lhs``/``rhs``/``eq``, the ``*_expr``
 suffix, and attribute access ``.lhs`` / ``.rhs``.  Escape hatch: put
 ``# lint: <rule>-ok`` on the flagged line (rule tokens: ``expr-eq``,
 ``expr-key``, ``devices``, ``mesh``, ``compile-direct``,
 ``bare-device-call``, ``ckpt-unguarded``, ``trace-id``,
-``phase-site``).
+``phase-site``, ``cap-const``).
 
 Usage: ``python tools/repo_lint.py [paths...]`` — defaults to the
 repo root; exit 1 when anything fires.
@@ -549,6 +563,97 @@ def _lint_phase_sites(tree: ast.AST, relpath: str,
     return findings
 
 
+# ---- CAP-CONST -----------------------------------------------------------
+#: the lane-tile extent — unmistakable wherever it appears in scope
+_CAP_LANE = 128
+#: sublane fold/tile extents by dtype — only flagged in alignment
+#: arithmetic (``x % 8`` / ``x // 8``) and itemsize→sublane dict maps,
+#: where they are layout facts; a bare ``8`` elsewhere is usually a
+#: loop bound or heuristic and stays legal
+_CAP_SUBLANES = {8, 16, 32}
+_MIB = 2 ** 20
+
+
+def _cap_const_in_scope(relpath: str) -> bool:
+    """The single-source-of-truth perimeter: geometry (VarGeom/
+    lowering), the planner, the pallas build, and the checker —
+    everything that would let the static model and the runtime drift if
+    they each kept a private copy of the probed rules.  The capability
+    table itself is the sanctioned home."""
+    if relpath.startswith(os.path.join("yask_tpu", "backend") + os.sep):
+        return False
+    return (relpath in (os.path.join("yask_tpu", "compiler",
+                                     "lowering.py"),
+                        os.path.join("yask_tpu", "ops",
+                                     "tile_planner.py"),
+                        os.path.join("yask_tpu", "ops",
+                                     "pallas_stencil.py"))
+            or relpath.startswith(
+                os.path.join("yask_tpu", "checker") + os.sep))
+
+
+def _is_mib_pow(node: ast.AST) -> bool:
+    """``2 ** 20`` or the literal 1048576."""
+    if isinstance(node, ast.Constant) and node.value == _MIB:
+        return True
+    return (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Pow)
+            and isinstance(node.left, ast.Constant)
+            and node.left.value == 2
+            and isinstance(node.right, ast.Constant)
+            and node.right.value == 20)
+
+
+def _lint_cap_consts(tree: ast.AST, relpath: str,
+                     lines: List[str]) -> List[dict]:
+    findings = []
+    # dict KEYS are exempt: itemsize→dtype maps key on element bytes
+    dict_keys = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Dict):
+            for k in n.keys:
+                if k is not None:
+                    dict_keys.add(id(k))
+
+    def _add(node, what: str) -> None:
+        line = (lines[node.lineno - 1]
+                if node.lineno - 1 < len(lines) else "")
+        if "# lint: cap-const-ok" in line:
+            return
+        findings.append({
+            "rule": "CAP-CONST", "path": relpath, "line": node.lineno,
+            "message": f"{what} — backend legality facts live in "
+                       "yask_tpu/backend/capability.py; read them "
+                       "through get_capability()/tpu_tile_dims/"
+                       "sublane_count/vmem_limit_bytes (or pragma a "
+                       "genuinely backend-independent constant)"})
+
+    for n in ast.walk(tree):
+        if (isinstance(n, ast.Constant) and n.value == _CAP_LANE
+                and id(n) not in dict_keys):
+            _add(n, f"raw lane-tile literal {_CAP_LANE}")
+        elif isinstance(n, ast.BinOp):
+            if (isinstance(n.op, (ast.Mod, ast.FloorDiv))
+                    and isinstance(n.right, ast.Constant)
+                    and n.right.value in _CAP_SUBLANES):
+                _add(n, f"sublane alignment arithmetic on raw "
+                        f"{n.right.value}")
+            elif isinstance(n.op, ast.Mult):
+                for a, b in ((n.left, n.right), (n.right, n.left)):
+                    if (isinstance(a, ast.Constant)
+                            and isinstance(a.value, int)
+                            and _is_mib_pow(b)):
+                        _add(n, f"constant VMEM byte value "
+                                f"{a.value} MiB")
+                        break
+        elif isinstance(n, ast.Dict):
+            subs = [v for v in n.values
+                    if isinstance(v, ast.Constant)
+                    and v.value in _CAP_SUBLANES]
+            if len(subs) >= 2:
+                _add(n, "itemsize→sublane dict map")
+    return findings
+
+
 def lint_file(path: str, root: str) -> List[dict]:
     relpath = os.path.relpath(path, root)
     with open(path, encoding="utf-8") as f:
@@ -568,6 +673,8 @@ def lint_file(path: str, root: str) -> List[dict]:
         findings.extend(_lint_trace_id(tree, relpath, lines))
     if _phase_site_in_scope(relpath):
         findings.extend(_lint_phase_sites(tree, relpath, lines))
+    if _cap_const_in_scope(relpath):
+        findings.extend(_lint_cap_consts(tree, relpath, lines))
     return findings
 
 

@@ -1,0 +1,231 @@
+"""Backend capability table — THE single legality/layout oracle.
+
+YASK's compiler owes its portability to one discipline: every
+target's legality facts (vector fold shapes, alignment, intrinsics)
+live in one target description that both code generation and
+validation consult.  This module is the TPU-era equivalent: a frozen,
+versioned table (schema ``yask_tpu.capability/1``) encoding what was
+**probed on real hardware** (v5e, round 3 — see CLAUDE.md "Mosaic TC
+rules"), consumed by every layer that used to bake the same numbers in
+as module constants:
+
+* ``lowering.tpu_tile_dims`` / ``VarGeom`` pad math — :meth:`tile_dims`;
+* ``tile_planner.sublane_count`` / ``plan_blocks`` — :meth:`sublane_count`
+  and :meth:`tile_cells`;
+* ``pallas_stencil.vmem_limit_bytes`` / ``default_vmem_budget`` —
+  :meth:`vmem_limit_bytes` and :meth:`plan_budget_bytes`;
+* the auto-tuner's VMEM ladder — :attr:`vmem_ladder_mib`;
+* the checker's ``mosaic`` / ``vmem`` passes — the same accessors, so
+  the static model *cannot* drift from the runtime.
+
+``tools/repo_lint.py``'s ``CAP-CONST`` rule flags raw lane/sublane/
+VMEM-byte literals re-appearing in those modules; this file is the
+only sanctioned home for them.  ``tools/checker_conformance.py``
+differentially tests that the checker's static verdicts match what the
+runtime actually does for randomized solutions.
+
+Entries:
+
+* ``tpu:v5e`` — the probed Mosaic TensorCore rules.
+* ``cpu:interpret`` — the Pallas interpret-mode host.  It DELIBERATELY
+  carries the TPU's legality facts (round-8 invariant: a CPU-host
+  check must answer for Mosaic), differing only in the planning-budget
+  default (VMEM is emulated under interpret; a loose budget only
+  shapes planning).
+
+Extension recipe (what a ``pallas:triton`` entry would fill in) is in
+``docs/checking.md``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Dict, Optional, Tuple
+
+SCHEMA = "yask_tpu.capability/1"
+
+#: env override for the default backend entry (tests / future targets)
+_ENV_KNOB = "YT_BACKEND"
+
+
+@dataclass(frozen=True)
+class BackendCapability:
+    """Legality + layout facts of one execution backend.
+
+    Frozen: a capability is data, not policy — consumers derive their
+    decisions from it but never mutate it.  All ``*_mib`` fields are
+    MiB (the probed numbers are round MiB values); byte values come
+    from the accessor methods.
+    """
+
+    #: registry key, e.g. ``"tpu:v5e"``
+    name: str
+    #: coarse family: ``"tpu"`` (real Mosaic) or ``"cpu"`` (interpret)
+    kind: str
+
+    # ---- register/DMA tiling (probed v5e, round 3) -------------------
+    #: lane (last physical axis) tile extent — every dtype
+    lane_tile: int = 128
+    #: bytes per sublane tile row: sublane extent scales with element
+    #: width (32 B ⇒ 8 for f32, 16 for bf16)
+    sublane_tile_bytes: int = 32
+    #: floor for the planner's sublane fold unit (f64's 4-row DMA tile
+    #: still plans blocks in 8-row folds)
+    min_sublane_fold: int = 8
+    #: DMA windows on HBM/ANY refs need lane-tile-multiple sizes AND
+    #: offsets (a full-extent slice of a non-multiple lane total is
+    #: itself unaligned: physical tiled layout ≠ logical extent)
+    dma_tile_aligned: bool = True
+    #: misc axes must be physically FIRST (the trailing two axes belong
+    #: to the sublane×lane tiling)
+    misc_axes_first: bool = True
+    #: only the solution-minor domain dim may ride the lane axis of a
+    #: DMA-windowed var (anything else needs pid-dependent non-aligned
+    #: offsets → pallas fallback)
+    minor_dim_lane_only: bool = True
+    #: no-domain-dim vars ride SMEM with static scalar reads
+    smem_scalars: bool = True
+    #: skew/trapezoid write-back windows on the sublane axis must stay
+    #: sublane-tile aligned (shifted output DMAs)
+    sublane_aligned_writes: bool = True
+
+    # ---- in-kernel op vocabulary (Mosaic TC rejections, probed) ------
+    #: op classes the kernel generator must never emit (static region
+    #: inserts go through lax.pad + broadcasted_iota masks instead)
+    banned_kernel_ops: Tuple[str, ...] = (
+        "dynamic_update_slice", "scatter", "sort", "gather",
+        "1d_iota_on_lane_axis",
+    )
+    #: expression-node vocabulary the in-kernel evaluator can lower
+    #: with legal patterns (the checker's MOSAIC-KERNEL-OPS rule)
+    kernel_expr_nodes: Tuple[str, ...] = (
+        "ConstExpr", "VarPoint", "IndexExpr", "FirstIndexExpr",
+        "LastIndexExpr", "NegExpr", "AddExpr", "MultExpr", "SubExpr",
+        "DivExpr", "ModExpr", "FuncExpr", "CompExpr", "AndExpr",
+        "OrExpr", "NotExpr", "EqualsExpr",
+    )
+
+    # ---- VMEM (probed v5e, rounds 3/5) -------------------------------
+    #: Mosaic's default scoped VMEM limit before CompilerParams raises it
+    vmem_default_scope_mib: int = 16
+    #: probed usable scoped VMEM (v5e takes ≥ this)
+    vmem_probed_mib: int = 120
+    #: cap for the requested scoped limit (safely below the probed
+    #: 120..128 range)
+    vmem_limit_cap_mib: int = 128
+    #: live SSA values ≈ this many copies of the tiles (the round-3
+    #: register-spill OOM model)
+    vmem_live_multiplier: int = 2
+    #: default planning TILE budget: live_multiplier × budget must fit
+    #: the scoped limit, so the model budgets half the cap
+    plan_budget_mib: int = 64
+    #: the auto-tuner's VMEM-budget ladder rungs
+    vmem_ladder_mib: Tuple[int, ...] = (64, 96, 120)
+
+    #: free-form provenance notes (probe round, hardware)
+    notes: Dict[str, str] = field(default_factory=dict)
+
+    # ---- derived accessors -------------------------------------------
+
+    def tile_dims(self, dtype) -> Tuple[int, int]:
+        """(sublane, lane) DMA/register tile extents of the last two
+        physical axes for ``dtype`` (8×128 for f32, 16×128 for bf16).
+        THE single definition behind ``lowering.tpu_tile_dims``."""
+        import numpy as np
+        esize = np.dtype(dtype).itemsize
+        sub = max(1, self.sublane_tile_bytes // max(1, esize))
+        return sub, self.lane_tile
+
+    def sublane_count(self, dtype) -> int:
+        """The planner's sublane fold unit for ``dtype``: the DMA
+        sublane tile, floored at :attr:`min_sublane_fold` (f64's 4-row
+        tile still folds in 8s)."""
+        return max(self.min_sublane_fold, self.tile_dims(dtype)[0])
+
+    def tile_cells(self, dtype) -> int:
+        """Cells per vector register tile (sublane fold × lane)."""
+        return self.sublane_count(dtype) * self.lane_tile
+
+    def vmem_limit_bytes(self, vmem_budget: int) -> int:
+        """Scoped Mosaic VMEM limit requested for a tile budget:
+        live_multiplier × budget (live SSA values ≈ extra tile copies),
+        capped below the probed ceiling.  THE single definition the
+        kernel's CompilerParams and the checker's spill model share."""
+        return int(min(self.vmem_limit_cap_mib * 2 ** 20,
+                       self.vmem_live_multiplier * vmem_budget))
+
+    def plan_budget_bytes(self) -> int:
+        """Default Pallas tile-planning budget (the ``-vmem_mb`` knob
+        overrides)."""
+        return self.plan_budget_mib * 2 ** 20
+
+    def vmem_ladder_bytes(self) -> Tuple[int, ...]:
+        return tuple(mb * 2 ** 20 for mb in self.vmem_ladder_mib)
+
+    def to_json(self) -> dict:
+        """Schema-stamped dict (``yask_tpu.capability/1``)."""
+        out = {"schema": SCHEMA}
+        out.update(asdict(self))
+        return out
+
+
+_REGISTRY: Dict[str, BackendCapability] = {}
+
+
+def register_capability(cap: BackendCapability) -> BackendCapability:
+    """Register a backend entry (the extension point: a new target is
+    a table entry plus — at most — a new kernel emitter, never edits
+    to the planner/checker constants)."""
+    if cap.name in _REGISTRY:
+        raise ValueError(f"duplicate backend capability '{cap.name}'")
+    _REGISTRY[cap.name] = cap
+    return cap
+
+
+def backend_names() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))
+
+
+#: the probed v5e TensorCore rules — every number here has hardware
+#: provenance (CLAUDE.md "Mosaic TC rules", docs/checking.md)
+TPU_V5E = register_capability(BackendCapability(
+    name="tpu:v5e", kind="tpu",
+    notes={"provenance": "probed on v5e, rounds 3-5",
+           "vmem": "scoped limit raised via CompilerParams; >=120 MiB "
+                   "usable; live SSA values ~double tile usage"},
+))
+
+#: Pallas interpret mode on a CPU host.  Legality facts DELIBERATELY
+#: model the TPU (round-8 invariant: a CPU-host check must answer for
+#: Mosaic); only the planning budget is looser — VMEM is emulated, the
+#: budget only shapes planning.
+CPU_INTERPRET = register_capability(BackendCapability(
+    name="cpu:interpret", kind="cpu",
+    plan_budget_mib=100,
+    notes={"provenance": "mirror of tpu:v5e legality by design",
+           "vmem": "emulated; budget shapes planning only"},
+))
+
+
+def get_capability(name: Optional[str] = None) -> BackendCapability:
+    """THE accessor every consumer reads the table through.
+
+    ``name`` picks an entry; ``None`` resolves ``YT_BACKEND`` and
+    falls back to ``tpu:v5e`` — legality questions always answer for
+    the real target, even on a CPU host (checker invariant)."""
+    key = name or os.environ.get(_ENV_KNOB) or "tpu:v5e"
+    try:
+        return _REGISTRY[key]
+    except KeyError:
+        raise KeyError(
+            f"unknown backend capability '{key}'; registered: "
+            f"{', '.join(backend_names())}") from None
+
+
+def capability_for_platform(platform: str) -> BackendCapability:
+    """Map a jax platform string to its capability entry (``tpu`` and
+    the axon relay alias → ``tpu:v5e``; anything else plans as the
+    interpret host)."""
+    return get_capability(
+        "tpu:v5e" if platform in ("tpu", "axon") else "cpu:interpret")

@@ -80,6 +80,7 @@ def run_checks(ctx, passes=None) -> CheckReport:
         except YaskException as e:
             plan_error = e
 
+    from yask_tpu.backend import get_capability
     opts = ctx._opts
     report = CheckReport(config={
         "stencil": ctx.get_name(),
@@ -88,6 +89,7 @@ def run_checks(ctx, passes=None) -> CheckReport:
         "wf_steps": opts.wf_steps,
         "vmem_mb": opts.vmem_budget_mb or 0,
         "dtype": _dtype_name(getattr(ctx._csol, "dtype", None)),
+        "backend": get_capability().name,
     })
 
     if plan_error is not None:
@@ -169,6 +171,18 @@ def preflight(ctx, out=None, verbose: bool = False) -> bool:
         # the full traceback, so a swallowed checker bug is debuggable
         # from the session log instead of silently vanishing
         out.write(traceback.format_exc())
+        # ...and a journal row, so a crashing pass is VISIBLE in the
+        # session evidence instead of only scrolling past on stderr
+        # (LOG-ONLY contract unchanged: the launch still proceeds)
+        try:
+            from yask_tpu.resilience.journal import (SessionJournal,
+                                                     default_journal_path)
+            SessionJournal(default_journal_path()).record(
+                "preflight", case=ctx.get_name(),
+                outcome="preflight_error",
+                error_type=type(e).__name__, error=str(e)[:500])
+        except Exception:
+            pass  # the journal must never cost the launch either
         return True
     if report.errors or report.warnings or verbose:
         out.write(report.render(verbose=verbose))

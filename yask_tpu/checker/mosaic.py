@@ -16,20 +16,19 @@ into diagnostics here rather than an on-hardware Mosaic crash.
 
 from __future__ import annotations
 
+from yask_tpu.backend import get_capability
 from yask_tpu.checker.diagnostics import CheckReport
 
 PASS = "mosaic"
 
-#: Expr node types the in-kernel evaluator (``_TileEval``) lowers —
-#: anything outside this set cannot be expressed with the legal Mosaic
-#: pattern vocabulary (lax.pad + broadcasted_iota masks + jnp.where; no
-#: dynamic_update_slice, no scatter) and would die in the generator.
-_SUPPORTED_NODES = (
-    "ConstExpr", "VarPoint", "IndexExpr", "FirstIndexExpr",
-    "LastIndexExpr", "NegExpr", "AddExpr", "MultExpr", "SubExpr",
-    "DivExpr", "ModExpr", "FuncExpr", "CompExpr", "AndExpr", "OrExpr",
-    "NotExpr", "EqualsExpr",
-)
+
+def _supported_nodes():
+    """Expr node types the in-kernel evaluator (``_TileEval``) lowers —
+    anything outside the backend's ``kernel_expr_nodes`` vocabulary
+    cannot be expressed with the legal Mosaic patterns (lax.pad +
+    broadcasted_iota masks + jnp.where; no dynamic_update_slice, no
+    scatter) and would die in the generator."""
+    return get_capability().kernel_expr_nodes
 
 
 def _walk_nodes(e):
@@ -144,10 +143,11 @@ def check_mosaic(report: CheckReport, ctx, program) -> None:
     # node vocabulary below (everything else would need
     # dynamic_update_slice / scatter, which Mosaic TC rejects — static
     # region inserts go through lax.pad + broadcasted_iota instead).
+    supported = _supported_nodes()
     for eq in ctx._csol.soln.get_equations():
         for node in _walk_nodes(eq):
             tname = type(node).__name__
-            if tname not in _SUPPORTED_NODES:
+            if tname not in supported:
                 report.add("MOSAIC-KERNEL-OPS", "error",
                            f"equation '{eq.format_simple()}' contains "
                            f"a {tname} node the in-kernel evaluator "

@@ -18,6 +18,7 @@ itself — the model cannot drift from the code it predicts.
 
 from __future__ import annotations
 
+from yask_tpu.backend import get_capability
 from yask_tpu.checker.diagnostics import CheckReport
 from yask_tpu.utils.exceptions import YaskException
 
@@ -135,7 +136,7 @@ def check_vmem(report: CheckReport, ctx, program) -> None:
                        detail={"vmem_budget": budget, "message": str(e)})
             continue
         tile = plan["tile_bytes"]
-        live = 2 * tile
+        live = get_capability().vmem_live_multiplier * tile
         det = {"vmem_budget": budget, "vmem_limit": limit,
                "tile_bytes": tile, "live_model_bytes": live,
                "block": plan["block"], "fuse_steps": plan["fuse_steps"],
@@ -152,7 +153,8 @@ def check_vmem(report: CheckReport, ctx, program) -> None:
                 f"{limit / 2**20:.0f} MiB — the round-3 register-spill "
                 "OOM class (spill slots > vmem_limit); shrink block, "
                 "fuse_steps, or the budget", detail=det)
-        elif 2 * budget > limit and live > _NEAR_LIMIT * limit:
+        elif (get_capability().vmem_live_multiplier * budget > limit
+              and live > _NEAR_LIMIT * limit):
             # only in the cap-bound regime (budget > 64 MiB): below it
             # live = 2·tile ≤ 2·budget = limit holds by construction,
             # and the default budget is DESIGNED to fill it exactly
@@ -194,7 +196,7 @@ def _check_trapezoid(report: CheckReport, ctx, program, plan,
     trap_dims = plan.get("trap_dims", [])
     for sub in plan.get("diamond", []):
         stile = sub["tile_bytes"]
-        slive = 2 * stile
+        slive = get_capability().vmem_live_multiplier * stile
         sdet = {"vmem_budget": budget, "vmem_limit": limit,
                 "tile_bytes": stile, "live_model_bytes": slive,
                 "diamond_dim": sub.get("diamond_dim"),

@@ -565,6 +565,23 @@ class SolutionAnalysis:
             out.append(reads)
         return out
 
+    def read_var_names(self) -> Set[str]:
+        """Names of every non-scratch var READ by any equation, at ANY
+        offset — including pure same-point (zero-domain-offset) reads,
+        which :meth:`stage_read_widths` deliberately omits (they need no
+        ghost margin).  The Pallas skew carry must consult THIS set: a
+        written var consumed only at the same point (awp's anelastic
+        memory vars — ``r(t+1) = q·(r(t)+el)`` read back by the stress
+        stage) still crosses sub-steps, so its slid-region left strips
+        ride the inter-tile carry exactly like offset reads do."""
+        out: Set[str] = set()
+        for eq in self.eqs:
+            for p in self._reads_of(eq):
+                v = p.get_var()
+                if not v.is_scratch():
+                    out.add(v.get_name())
+        return out
+
     def fused_step_radius(self) -> Dict[str, int]:
         """Per domain dim, the (symmetric) margin ONE full step consumes
         when fused in-tile: the sum over stages of each stage's max ghost

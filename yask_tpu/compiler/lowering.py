@@ -199,12 +199,11 @@ class NumpyOps(ArrayOps):
 
 def tpu_tile_dims(dtype) -> Tuple[int, int]:
     """(sublane, lane) tile extents of the last two physical axes for
-    ``dtype`` (8×128 for f32, 16×128 for bf16). THE single definition —
-    VarGeom's allocation alignment and the pallas DMA slab planner must
-    agree or slab windows stop matching allocations."""
-    import numpy as np
-    esize = np.dtype(dtype).itemsize
-    return max(1, (8 * 4) // max(1, esize)), 128
+    ``dtype`` (8×128 for f32, 16×128 for bf16) — read from the backend
+    capability table so VarGeom's allocation alignment, the pallas DMA
+    slab planner, and the checker all consult ONE definition."""
+    from yask_tpu.backend import get_capability
+    return get_capability().tile_dims(dtype)
 
 
 class VarGeom:

@@ -36,6 +36,7 @@ from functools import partial
 from typing import Dict, List, Optional, Tuple
 
 from yask_tpu.utils.exceptions import YaskException
+from yask_tpu.ops.tile_planner import _INTERPRET_PLAN_BUDGET
 from yask_tpu.compiler.expr import (
     AddExpr,
     AndExpr,
@@ -476,23 +477,25 @@ def default_vmem_budget(platform: str) -> int:
     values (≈ a second copy of the tiles) still fit under the raised
     limit. Under CPU interpret VMEM is emulated and the budget only
     shapes planning. Single definition for the runtime context, harness
-    tools, and bench."""
-    return 64 * 2 ** 20 if platform == "tpu" else 100 * 2 ** 20
+    tools, and bench — reads the backend capability table."""
+    from yask_tpu.backend import capability_for_platform
+    return capability_for_platform(platform).plan_budget_bytes()
 
 
 def vmem_limit_bytes(vmem_budget: int) -> int:
     """Scoped Mosaic VMEM limit requested for a given tile budget:
-    2× the budget (live SSA values ≈ a second copy of the tiles),
-    capped at the 128 MiB that is safely below the ≥120..128 MiB range
-    probed on v5e.  Single definition — the kernel's CompilerParams and
-    the static checker's spill model both use it."""
-    return int(min(128 * 2 ** 20, 2 * vmem_budget))
+    live-multiplier × the budget (live SSA values ≈ a second copy of
+    the tiles), capped safely below the probed v5e ceiling.  Single
+    definition — the kernel's CompilerParams and the static checker's
+    spill model both use it; the numbers live in the capability table."""
+    from yask_tpu.backend import get_capability
+    return get_capability().vmem_limit_bytes(vmem_budget)
 
 
 def build_pallas_chunk(program, fuse_steps: int = 1,
                        block: Optional[Tuple[int, ...]] = None,
                        interpret: bool = False,
-                       vmem_budget: int = 100 * 2 ** 20,
+                       vmem_budget: int = _INTERPRET_PLAN_BUDGET,
                        distributed: bool = False,
                        pipeline_dmas: Optional[bool] = None,
                        skew=None,
@@ -1138,11 +1141,24 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # dim's reader is the very next sequential step (one strip); the
     # outer dim's reader runs a whole inner row later, so its carry
     # keeps one strip per inner-grid position.
-    carry_vars = ([n for n in written if n in ring_read_vars]
+    # Carry EVERY written var that is read back at all — not just the
+    # offset-read set (``stage_reads`` omits pure same-point reads, but
+    # a same-point consumer at the next sub-step still reads the slid
+    # region's left strip, which only the neighboring tile computed:
+    # awp's anelastic memory vars corrupted a radius-wide band when
+    # they were left out of the carry).
+    carry_vars = ([n for n in written
+                   if n in ring_read_vars
+                   or n in ana.read_var_names()]
                   if use_skew else [])
     carr_base: Dict[Tuple[str, str], int] = {}
     for _d in skew_dims:
         for _n in carry_vars:
+            # vars without the skewed dim (misc-only SMEM riders) have
+            # no strip geometry in it — their values are domain-
+            # independent and recomputed identically by every tile
+            if not any(dn == _d for dn, _k in program.geoms[_n].axes):
+                continue
             carr_base[_d, _n] = len(carr_base)
 
     def carry_shape(dim, name):
@@ -1800,6 +1816,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             if use_skew and carry_vars and k >= 1:
                 for dim in skew_dims:
                     for n in carry_vars:
+                        if (dim, n) not in carr_base:
+                            continue
                         Dn = slots[n]
                         ring = tiles[n]
                         for j in range(len(ring)):
@@ -1825,6 +1843,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                 # this sub-step's (above) — reads precede the overwrite
                 for dim in skew_dims:
                     for n in carry_vars:
+                        if (dim, n) not in carr_base:
+                            continue
                         Dn = slots[n]
                         ring = tiles[n]
                         if k < K - 1:

@@ -21,11 +21,18 @@ from __future__ import annotations
 import math
 from typing import Dict, List, Optional, Tuple
 
+from yask_tpu.backend import get_capability
+
+#: default planning budget for direct/test calls (the runtime passes the
+#: platform's own default via ``default_vmem_budget``)
+_INTERPRET_PLAN_BUDGET = get_capability("cpu:interpret").plan_budget_bytes()
+
 
 def sublane_count(dtype) -> int:
-    import numpy as np
-    size = np.dtype(dtype).itemsize
-    return {4: 8, 2: 16, 1: 32}.get(size, 8)
+    """Sublane fold unit for ``dtype`` (8 for f32, 16 for bf16) — read
+    from the backend capability table (single source with VarGeom's
+    alignment and the checker's models)."""
+    return get_capability().sublane_count(dtype)
 
 
 def _ceil_to(x: int, m: int) -> int:
@@ -325,7 +332,7 @@ class TilePlan:
 
 
 def plan_blocks(program, fuse_steps: int = 1,
-                vmem_budget: int = 100 * 2 ** 20,
+                vmem_budget: int = _INTERPRET_PLAN_BUDGET,
                 vinstr_cap: int = 300_000,
                 min_block: Optional[Dict[str, int]] = None,
                 margin_override: Optional[Dict[str, int]] = None
@@ -363,7 +370,8 @@ def plan_blocks(program, fuse_steps: int = 1,
     for d, m in (margin_override or {}).items():
         if d in marg:
             marg[d] = m
-    sub = sublane_count(program.dtype)
+    cap = get_capability()
+    sub = cap.sublane_count(program.dtype)
 
     fold = program.soln.get_settings().fold
 
@@ -374,7 +382,7 @@ def plan_blocks(program, fuse_steps: int = 1,
         if fold.has_dim(d) and fold[d] > 0:
             block[d] = min(fold[d], sizes[d])
         elif i == len(lead) - 1:
-            block[d] = min(max(sub, 8), sizes[d])
+            block[d] = min(sub, sizes[d])
         else:
             block[d] = min(8, sizes[d])
 
@@ -440,7 +448,7 @@ def plan_blocks(program, fuse_steps: int = 1,
         per = 1
         for d in lead:
             per *= blk[d] + marg[d]
-        vregs = per * minor_ext / (sub * 128)
+        vregs = per * minor_ext / cap.tile_cells(program.dtype)
         return num_ops * fuse_steps * vregs
 
     # per-dim floors (the skew carry needs stream blocks ≥ (ring+1)·r —

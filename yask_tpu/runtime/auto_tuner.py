@@ -22,6 +22,7 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Tuple
 
+from yask_tpu.backend import get_capability
 from yask_tpu.resilience import (Breaker, CompilerOOM, classify,
                                  fault_point)
 
@@ -37,8 +38,10 @@ class AutoTuner:
     #: ≥120 MiB, so the upper rungs admit wider blocks (at 512³ r=8 K=2
     #: the 64→96 step is the difference between 8×32 and 16×32 x-blocks)
     #: while Mosaic VMEM OOMs on over-eager rungs are caught as
-    #: infeasible candidates, never fatal.
-    VMEM_LADDER_MIB = (64, 96, 120)
+    #: infeasible candidates, never fatal.  The rungs live in the
+    #: backend capability table (single source with the checker's
+    #: budget sweep).
+    VMEM_LADDER_MIB = get_capability().vmem_ladder_mib
 
     def __init__(self, ctx):
         self.ctx = ctx

@@ -1165,12 +1165,25 @@ class StencilContext:
     # ------------------------------------------------------------------
 
     def compare_data(self, other: "StencilContext", epsilon: float = 1e-4,
-                     abs_epsilon: float = 1e-7) -> int:
+                     abs_epsilon: float = 1e-7,
+                     field_epsilon: float = 0.0) -> int:
         """Element-wise compare of all common vars against another context;
         returns #mismatches. Mixed absolute+relative tolerance like the
         reference's within-tolerance check (``compare_data``): a point
         mismatches only if |x−y| > abs_eps + eps·max(|x|,|y|), so fp32
-        reassociation noise at near-cancellation points doesn't count."""
+        reassociation noise at near-cancellation points doesn't count.
+
+        ``field_epsilon`` adds a FIELD-scale term to the tolerance:
+        ``field_eps · max(‖x‖∞, ‖y‖∞)`` per compared array.  Stencil
+        updates sum neighbor values, so rounding error at a point is
+        ulps of the largest summed INPUT, not of the local result — a
+        point whose true value nearly cancels to zero can carry an
+        absolute error of ~ulp(field max) that no pointwise relative
+        tolerance models.  Use it when comparing execution paths with
+        different FP association (fused in-tile vs XLA-fused order);
+        the default 0.0 keeps the strict pointwise behavior.  A real
+        geometry bug (dropped halo band, stale margin) produces
+        O(field) errors and still fails any small field_epsilon."""
         self._check_prepared()
         other._check_prepared()
         self._materialize_state()
@@ -1200,6 +1213,9 @@ class StencilContext:
                     bad += x.size
                     continue
                 tol = abs_epsilon + epsilon * np.maximum(np.abs(x), np.abs(y))
+                if field_epsilon > 0.0 and x.size:
+                    scale = max(np.abs(x).max(), np.abs(y).max())
+                    tol = tol + field_epsilon * scale
                 bad += int((np.abs(x - y) > tol).sum())
         return bad
 
