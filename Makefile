@@ -8,7 +8,7 @@ TEST_ENV ?= PALLAS_AXON_POOL_IPS=
 .PHONY: all native capi test test-fast scratch-tests boundary-tests \
         stages-tests mode-tests bench perfcheck faultcheck commcheck \
         cachecheck servecheck obscheck telemetrycheck examples clean \
-        list-stencils lint check conformance conformance-quick
+        list-stencils lint check conformance conformance-quick loadcheck
 
 all: native test
 
@@ -91,10 +91,19 @@ telemetrycheck: lint
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m pytest \
 		tests/test_telemetry.py -q
 
+# seeded deterministic elastic-fleet closed loop on CPU: latency-burn
+# spike -> journaled scale_up -> warm spawn (zero lowerings) ->
+# admission recovery -> idle drain scale_down with sessions migrated
+# zero-lost (see docs/serving.md "Autoscaling"; the chaos soak and
+# trace replay are the slow-marked pytest side of the same harness)
+loadcheck: lint
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) tools/load_harness.py --check
+
 # static checker over the flagship configs: Mosaic legality, VMEM
 # feasibility (incl. the round-3 spill-OOM class), races, explain.
 # See docs/checking.md; nonzero exit on any error-severity finding.
-check: cachecheck servecheck obscheck telemetrycheck conformance-quick
+check: cachecheck servecheck obscheck telemetrycheck conformance-quick \
+       loadcheck
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker \
 		-stencil iso3dfd -radius 8 -g 256 -mode pallas -wf_steps 2
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker -all_stencils

@@ -125,6 +125,9 @@ def test_stable_names_pinned():
                                "serve.requests.rejected",
                                "serve.degraded",
                                "serve.preempted")
+    assert STABLE_COUNTER_PREFIXES == ("serve.requests.",
+                                       "serve.cache.",
+                                       "serve.overload.")
     assert STABLE_GAUGES == ("serve.queue_depth",)
     assert STABLE_HISTOGRAMS == ("serve.queue_ms", "serve.run_ms",
                                  "serve.total_ms",

@@ -164,11 +164,21 @@ class ServeFront:
         return out
 
     def op_open(self, msg):
-        sid = self.server.open_session(
-            stencil=msg["stencil"], radius=msg.get("radius"),
-            g=msg.get("g", 16), mode=msg.get("mode", "jit"),
-            wf=int(msg.get("wf", 2)), options=msg.get("options", ""),
-            session=msg.get("session"), bucket=msg.get("bucket"))
+        from yask_tpu.serve.api import Overloaded
+        try:
+            sid = self.server.open_session(
+                stencil=msg["stencil"], radius=msg.get("radius"),
+                g=msg.get("g", 16), mode=msg.get("mode", "jit"),
+                wf=int(msg.get("wf", 2)),
+                options=msg.get("options", ""),
+                session=msg.get("session"), bucket=msg.get("bucket"))
+        except Overloaded as e:
+            # brownout tier 2 / saturation: a STRUCTURED rejection —
+            # clients key on "overloaded" and honor the Retry-After
+            # hint instead of parsing the error string
+            return {"ok": False, "error": f"Overloaded: {e}",
+                    "overloaded": True,
+                    "retry_after": float(e.retry_after)}
         return {"ok": True, "sid": sid}
 
     def op_fill(self, msg):

@@ -50,7 +50,12 @@ def decode_array(d: Dict):
 
 
 class ServeClientError(RuntimeError):
-    pass
+    """Raised on a transport drop or an ``ok: false`` answer.  When
+    the server ANSWERED (as opposed to dying mid-op), the structured
+    response dict rides on :attr:`response` so callers — the fleet
+    front in particular — can pass status/anomaly fields through
+    instead of flattening them into an error string."""
+    response: Optional[Dict] = None
 
 
 class ServeClient:
@@ -125,8 +130,10 @@ class ServeClient:
                 continue
             break
         if not out.get("ok"):
-            raise ServeClientError(
+            err = ServeClientError(
                 out.get("error") or f"op {op!r} failed: {out}")
+            err.response = out
+            raise err
         return out
 
     # ------------------------------------------------------------- ops

@@ -59,11 +59,26 @@ Topology::
   the dead worker id, snapshot step and replayed step ranges →
   ``retry``).
 
+* **Elasticity** (``--autoscale`` / ``YT_FLEET_AUTOSCALE=1``): an
+  SLO-driven policy loop (``yask_tpu/serve/autoscale.py``) rides the
+  supervision cadence — scale UP warm-spawns a worker from the shared
+  compile cache (first request: zero lowerings), scale DOWN drains
+  the tail worker (stop admitting, in-flight runs finish, live
+  sessions snapshot + migrate through the failover path) before the
+  kill.  Every decision is a journaled ``scale_up`` / ``drain`` /
+  ``scale_down`` row carrying the triggering signal; decisions read
+  ONLY fresh telemetry (stale per-worker blocks are excluded — the
+  autoscaler never scales on dead data).  Saturation rejections are
+  structured: ``{"overloaded": true, "retry_after": ...}``
+  (worker-side brownout tiers live in the scheduler; see
+  ``docs/serving.md``).
+
 The fleet front performs no device work itself — every op is a
 forwarded worker call over pipes; the guarded device sites live in the
 workers' serve package.  Chaos injection: ``fleet.route`` (front),
-``fleet.heartbeat`` (front, a dropped heartbeat), and the worker-side
-``fleet.kill_worker`` / ``fleet.hang_worker`` sites in
+``fleet.heartbeat`` (front, a dropped heartbeat), ``fleet.scale`` /
+``fleet.drain`` (front, an aborted scaling action), and the
+worker-side ``fleet.kill_worker`` / ``fleet.hang_worker`` sites in
 ``tools/serve.py``.
 
 Usage::
@@ -139,6 +154,10 @@ class FleetWorker:
         self.lock = threading.Lock()  # serializes this worker's pipe
         self.sessions: set = set()
         self.hb_misses = 0
+        #: set by the autoscaler ahead of retirement: a draining
+        #: worker admits NO new sessions; in-flight work finishes and
+        #: live sessions migrate before the kill.
+        self.draining = False
 
     def alive(self) -> bool:
         """Process liveness (with a short grace for the EOF→exit
@@ -188,7 +207,8 @@ class ServeFleet:
                  journal_dir: Optional[str] = None,
                  worker_args: List[str] = (),
                  env: Optional[Dict[str, str]] = None,
-                 hb_secs: Optional[float] = None):
+                 hb_secs: Optional[float] = None,
+                 autoscale=None):
         from yask_tpu.serve.journal import ServeJournal
         self.closing = threading.Event()
         self._route_table: Dict[str, FleetWorker] = {}
@@ -213,6 +233,25 @@ class ServeFleet:
         #: last merged telemetry snapshot (banked by the heartbeat
         #: loop / refreshed by ``op metrics_snapshot``).
         self._telemetry: Optional[Dict] = None
+        #: per-worker-idx last GOOD snapshot poll: {"ts", "snap",
+        #: "gen"}.  A busy worker's block is carried forward from here
+        #: stamped with its age; past the staleness horizon it is
+        #: flagged ``stale`` and excluded from the merged fold — the
+        #: autoscaler must not scale on dead data.
+        self._snap_bank: Dict[int, Dict] = {}
+        #: the autoscaling policy loop (None = fixed-size fleet).
+        #: ``autoscale`` may be True (env-tuned policy), an
+        #: AutoscalePolicy instance (tests), or None → the
+        #: YT_FLEET_AUTOSCALE master switch decides.
+        self._autoscaler = None
+        if autoscale is None:
+            from yask_tpu.serve.autoscale import fleet_autoscale_enabled
+            autoscale = fleet_autoscale_enabled()
+        if autoscale:
+            from yask_tpu.serve.autoscale import AutoscalePolicy
+            self._autoscaler = autoscale \
+                if isinstance(autoscale, AutoscalePolicy) \
+                else AutoscalePolicy.from_env()
         self.workers: List[FleetWorker] = []
         for i in range(max(1, int(n_workers))):
             self.workers.append(self._spawn_worker(i))
@@ -239,6 +278,16 @@ class ServeFleet:
 
     # --------------------------------------------------------- routing
 
+    def _worker_at(self, idx: int) -> Optional[FleetWorker]:
+        """Bounds-safe slot lookup (caller need not hold the lock for
+        a racy identity probe).  After a scale-down pops the tail, a
+        stale worker ref's idx can exceed the list — that worker was
+        retired, not replaced, and the answer is None."""
+        with self._lock:
+            if 0 <= idx < len(self.workers):
+                return self.workers[idx]
+        return None
+
     def _route(self, sid: str) -> FleetWorker:
         """Affinity: the worker that owns this session."""
         from yask_tpu.resilience.faults import fault_point
@@ -252,17 +301,27 @@ class ServeFleet:
         return w
 
     def _admit(self) -> FleetWorker:
-        """Placement for a new session: least-loaded worker by live
-        queue depth then session count; reject when the whole fleet is
-        past the queue bound (saturation answers fast)."""
+        """Placement for a new session: least-loaded NON-DRAINING
+        worker by live queue depth then session count; reject with a
+        structured :class:`Overloaded` (Retry-After hint, journaled
+        ``overloaded`` row) when the whole fleet is past the queue
+        bound — saturation answers fast, it does not time out
+        slowly."""
         from yask_tpu.resilience.faults import fault_point
+        from yask_tpu.serve.api import Overloaded, serve_retry_after
         fault_point("fleet.route")
-        occ = [(w, w.occupancy()) for w in self.workers]
+        cands = [w for w in list(self.workers) if not w.draining] \
+            or list(self.workers)
+        occ = [(w, w.occupancy()) for w in cands]
         bound = fleet_max_queue()
         if all(o["queue_depth"] >= bound for _w, o in occ):
-            raise ServeClientError(
+            ra = serve_retry_after()
+            self.journal.record(
+                "-", "-", "overloaded", tier=2, retry_after=ra,
+                queue_bound=bound, workers=len(occ))
+            raise Overloaded(
                 f"fleet saturated: every worker's queue depth >= "
-                f"{bound} (YT_FLEET_MAX_QUEUE)")
+                f"{bound} (YT_FLEET_MAX_QUEUE)", retry_after=ra)
         occ.sort(key=lambda t: (t[1]["queue_depth"],
                                 t[1]["sessions"], t[0].idx))
         return occ[0][0]
@@ -284,9 +343,8 @@ class ServeFleet:
         consecutive misses declare it unhealthy.  Busy workers are
         skipped: the in-flight call path detects death by EOF."""
         for w in list(self.workers):
-            with self._lock:
-                if self.workers[w.idx] is not w:
-                    continue  # replaced since we listed
+            if self._worker_at(w.idx) is not w:
+                continue  # replaced or retired since we listed
             if not w.alive():
                 self._failover(w, cause="worker process exited")
                 continue
@@ -310,6 +368,12 @@ class ServeFleet:
         try:
             self.collect_telemetry(block=False)
         except Exception:  # noqa: BLE001 - telemetry must not take
+            pass           # supervision down
+        # elastic sizing rides the same cadence, AFTER the telemetry
+        # bank so decisions read this tick's freshness stamps
+        try:
+            self.autoscale_tick()
+        except Exception:  # noqa: BLE001 - scaling must not take
             pass           # supervision down
 
     def _ping_deadlined(self, w: FleetWorker) -> bool:
@@ -337,39 +401,74 @@ class ServeFleet:
         t.join(fleet_hb_deadline())
         return (not t.is_alive()) and "out" in result
 
+    def _stale_after(self) -> float:
+        """The staleness horizon: a per-worker block older than 3
+        heartbeat intervals is dead data (3 missed polls ≈ the worker
+        is hung or the loop is wedged).  Falls back to the liveness
+        deadline when no background loop runs (tests tick manually)."""
+        base = self._hb_secs if self._hb_secs > 0 \
+            else fleet_hb_deadline()
+        return 3.0 * base
+
     def collect_telemetry(self, block: bool = True) -> Dict:
         """Poll every worker's ``metrics_snapshot`` and merge into ONE
         fleet snapshot (``yask_tpu.obs.telemetry.merge_snapshots`` —
         histogram sample windows pooled and re-ranked; counters/gauges
         summed; per-worker blocks kept).  ``block=False`` is the
         heartbeat path: a busy worker is skipped rather than queued
-        behind its in-flight op, leaving its last-banked block out of
-        this tick.  The merged snapshot is banked on the fleet for
-        ``fleet_stats`` / ``op metrics_snapshot`` to answer from."""
+        behind its in-flight op — its LAST GOOD block is carried
+        forward instead, stamped with ``poll_age_secs``, and flagged
+        ``stale`` past :meth:`_stale_after` (``merge_snapshots``
+        excludes flagged blocks from the fold and lists them in
+        ``stale_workers``).  A replacement worker never inherits its
+        predecessor's bank: carried blocks are gen-checked.  The
+        merged snapshot is banked on the fleet for ``fleet_stats`` /
+        ``op metrics_snapshot`` to answer from."""
         import time
         from yask_tpu.obs.telemetry import merge_snapshots
+        now = time.time()
+        horizon = self._stale_after()
         per: Dict[str, Dict] = {}
         for w in list(self.workers):
             wid = f"w{w.idx}"
+            snap: Optional[Dict] = None
+            err = ""
             if block:
                 try:
                     out = w.call("metrics_snapshot")
+                    snap = dict(out.get("snapshot") or {})
                 except Exception as e:  # noqa: BLE001
-                    per[wid] = {"error": f"{type(e).__name__}: {e}"}
-                    continue
-            else:
-                if not w.lock.acquire(blocking=False):
-                    continue
+                    err = f"{type(e).__name__}: {e}"
+            elif w.lock.acquire(blocking=False):
                 try:
                     out = w.client.call("metrics_snapshot")
+                    snap = dict(out.get("snapshot") or {})
                 except Exception:  # noqa: BLE001
-                    continue
+                    snap = None
                 finally:
                     w.lock.release()
-            snap = dict(out.get("snapshot") or {})
-            snap["gen"] = w.gen
-            per[wid] = snap
-        merged = merge_snapshots(per, ts=time.time())
+            if snap is not None:
+                snap["gen"] = w.gen
+                snap["poll_age_secs"] = 0.0
+                with self._lock:
+                    self._snap_bank[w.idx] = {
+                        "ts": now, "snap": dict(snap), "gen": w.gen}
+                per[wid] = snap
+                continue
+            # busy or failed poll: carry the banked block forward,
+            # honestly aged — never a block from an older generation
+            with self._lock:
+                b = self._snap_bank.get(w.idx)
+            if b is not None and b["gen"] == w.gen:
+                age = max(0.0, now - b["ts"])
+                snap = dict(b["snap"])
+                snap["poll_age_secs"] = age
+                if age > horizon:
+                    snap["stale"] = True
+                per[wid] = snap
+            elif err:
+                per[wid] = {"error": err}
+        merged = merge_snapshots(per, ts=now)
         with self._lock:
             self._telemetry = merged
         return merged
@@ -380,8 +479,9 @@ class ServeFleet:
         loop, in-flight EOF) race to the fleet lock and the losers see
         the replacement already installed."""
         with self._lock:
-            if self.workers[w.idx] is not w:
-                return self.workers[w.idx]
+            cur = self._worker_at(w.idx)
+            if cur is not w:
+                return cur if cur is not None else w
             self.journal.record(
                 f"w{w.idx}.g{w.gen}", "-", "worker_dead",
                 worker=w.idx, gen=w.gen, cause=str(cause)[:200],
@@ -425,43 +525,203 @@ class ServeFleet:
         — the r14 contract makes the result bit-identical to an
         uninterrupted run).  Caller holds the fleet lock."""
         for sid in sorted(dead.sessions):
-            b = self._bank.get(sid)
-            try:
-                if b is None:
-                    raise ServeClientError("no banked open fields")
-                repl.call("open", **b["open"])
-                snap_step = None
-                if b["snapshot"] is not None:
-                    out = repl.call("restore", sid=sid,
-                                    meta=b["snapshot"]["meta"],
-                                    state=b["snapshot"]["state"])
-                    if not out.get("ok"):
-                        raise ServeClientError(
-                            "banked snapshot did not apply")
-                    snap_step = int(
-                        b["snapshot"]["meta"].get("cur_step", 0))
-                replayed = []
-                for m in b["log"]:
-                    repl.call(m["op"], **{k: v for k, v in m.items()
-                                          if k not in ("op", "id")})
-                    if m["op"] == "run":
-                        replayed.append(
-                            [int(m.get("first", 0)),
-                             m.get("last")])
-                self._route_table[sid] = repl
-                repl.sessions.add(sid)
-                self.journal.record(
-                    sid, sid, "failover", dead_worker=dead.idx,
-                    dead_gen=dead.gen, to_worker=repl.idx,
-                    to_gen=repl.gen, snapshot_step=snap_step,
-                    replayed=replayed)
-            except Exception as e:  # noqa: BLE001 - an unrecoverable
-                # session must not block the rest of the fleet
+            self._recover_one(sid, dead, repl)
+
+    def _recover_one(self, sid: str, src: FleetWorker,
+                     dst: FleetWorker, cause: str = "failover") -> bool:
+        """Migrate ONE session ``src`` → ``dst`` through the banked
+        checkpoint + replay-log path; journals a ``failover`` row
+        either way (``cause`` distinguishes a death from an autoscaler
+        drain).  An unrecoverable session is dropped from routing so
+        it cannot block the rest of the fleet."""
+        b = self._bank.get(sid)
+        try:
+            if b is None:
+                raise ServeClientError("no banked open fields")
+            dst.call("open", **b["open"])
+            snap_step = None
+            if b["snapshot"] is not None:
+                out = dst.call("restore", sid=sid,
+                               meta=b["snapshot"]["meta"],
+                               state=b["snapshot"]["state"])
+                if not out.get("ok"):
+                    raise ServeClientError(
+                        "banked snapshot did not apply")
+                snap_step = int(
+                    b["snapshot"]["meta"].get("cur_step", 0))
+            replayed = []
+            for m in b["log"]:
+                dst.call(m["op"], **{k: v for k, v in m.items()
+                                     if k not in ("op", "id")})
+                if m["op"] == "run":
+                    replayed.append(
+                        [int(m.get("first", 0)),
+                         m.get("last")])
+            with self._lock:
+                self._route_table[sid] = dst
+                dst.sessions.add(sid)
+                src.sessions.discard(sid)
+            self.journal.record(
+                sid, sid, "failover", dead_worker=src.idx,
+                dead_gen=src.gen, to_worker=dst.idx,
+                to_gen=dst.gen, snapshot_step=snap_step,
+                replayed=replayed, cause=cause)
+            return True
+        except Exception as e:  # noqa: BLE001 - an unrecoverable
+            # session must not block the rest of the fleet
+            with self._lock:
                 self._route_table.pop(sid, None)
-                self.journal.record(
-                    sid, sid, "failover", dead_worker=dead.idx,
-                    dead_gen=dead.gen, recovered=False,
-                    error=f"{type(e).__name__}: {e}")
+                src.sessions.discard(sid)
+            self.journal.record(
+                sid, sid, "failover", dead_worker=src.idx,
+                dead_gen=src.gen, recovered=False, cause=cause,
+                error=f"{type(e).__name__}: {e}")
+            return False
+
+    # ---------------------------------------------------- autoscaling
+
+    def autoscale_tick(self) -> None:
+        """One autoscaler pass (rides the supervision cadence, after
+        the telemetry bank).  No-op on a fixed-size fleet.  The policy
+        (yask_tpu/serve/autoscale.py) decides; this method is the
+        mechanism: warm spawn from the shared compile cache on UP,
+        drain + migrate + retire on DOWN."""
+        if self._autoscaler is None:
+            return
+        from yask_tpu.serve.autoscale import signals_from_snapshot
+        with self._lock:
+            merged = self._telemetry
+            n = len(self.workers)
+            nd = sum(1 for w in self.workers if w.draining)
+        sig = signals_from_snapshot(merged, n, nd)
+        dec = self._autoscaler.decide(sig)
+        if dec is None:
+            return
+        if dec.action == "up":
+            self._scale_up(dec)
+        elif dec.action == "down":
+            self._scale_down(dec)
+
+    def _scale_up(self, dec) -> Optional[FleetWorker]:
+        """Append one worker (warm spawn: the shared YT_COMPILE_CACHE
+        means its first request deserializes with zero lowerings) and
+        journal the decision joined to the triggering trace."""
+        from yask_tpu.resilience.faults import Fault, fault_point
+        try:
+            fault_point("fleet.scale")
+        except Fault as e:
+            self.journal.record(
+                "-", "-", "fault", site="fleet.scale", kind=e.kind,
+                error=str(e)[:200])
+            return None
+        with self._lock:
+            idx = len(self.workers)
+            w = self._spawn_worker(idx)
+            self.workers.append(w)
+        self.journal.record(
+            f"w{idx}.g0", "-", "scale_up",
+            trace_id=self._latest_breach_trace(),
+            worker=idx, reason=dec.reason, signal=dec.signal,
+            cache_dir=self.cache_dir)
+        return w
+
+    def _scale_down(self, dec) -> None:
+        """Retire the tail worker: journal ``drain``, stop admitting
+        (``draining`` flag), migrate every live session through the
+        checkpoint path, then kill and pop.  Only the TAIL is ever
+        retired so ``idx == list position`` stays invariant."""
+        from yask_tpu.resilience.faults import Fault, fault_point
+        with self._lock:
+            if len(self.workers) <= 1:
+                return
+            w = self.workers[-1]
+            if w.draining:
+                return  # a prior drain is still in flight
+            w.draining = True
+        self.journal.record(
+            f"w{w.idx}.g{w.gen}", "-", "drain", worker=w.idx,
+            gen=w.gen, reason=dec.reason, signal=dec.signal,
+            sessions=sorted(w.sessions))
+        try:
+            fault_point("fleet.drain")
+        except Fault as e:
+            with self._lock:
+                w.draining = False  # aborted: keep serving
+            self.journal.record(
+                "-", "-", "fault", site="fleet.drain", kind=e.kind,
+                error=str(e)[:200])
+            return
+        self._drain_worker(w, dec)
+
+    def _drain_worker(self, w: FleetWorker, dec) -> None:
+        """The mechanism behind a ``scale_down``: snapshot each live
+        session at the drain boundary (fresh checkpoint → zero
+        replay), migrate it to the least-loaded surviving worker, then
+        retire the drained worker.  Waiting on the worker lock inside
+        ``snapshot`` naturally lets in-flight (chunked) runs finish
+        first — nothing in flight is abandoned."""
+        migrated: List[str] = []
+        lost: List[str] = []
+        for sid in sorted(w.sessions):
+            self._bank_snapshot(sid)
+            dst = self._pick_target(exclude=w)
+            if dst is None:
+                with self._lock:
+                    self._route_table.pop(sid, None)
+                    w.sessions.discard(sid)
+                lost.append(sid)
+                continue
+            ok = self._recover_one(sid, w, dst, cause="drain")
+            (migrated if ok else lost).append(sid)
+        with self._lock:
+            if self.workers and self.workers[-1] is w:
+                self.workers.pop()
+            self._snap_bank.pop(w.idx, None)
+        self._kill_worker(w)
+        self.journal.record(
+            f"w{w.idx}.g{w.gen}", "-", "scale_down", worker=w.idx,
+            gen=w.gen, reason=dec.reason, signal=dec.signal,
+            migrated=migrated, lost=lost)
+
+    def _pick_target(self, exclude: FleetWorker) \
+            -> Optional[FleetWorker]:
+        """Least-loaded live, non-draining worker other than
+        ``exclude`` (the migration destination during a drain)."""
+        cands = [w for w in list(self.workers)
+                 if w is not exclude and not w.draining and w.alive()]
+        if not cands:
+            return None
+        occ = [(w, w.occupancy()) for w in cands]
+        occ.sort(key=lambda t: (t[1]["queue_depth"],
+                                t[1]["sessions"], t[0].idx))
+        return occ[0][0]
+
+    def _latest_breach_trace(self) -> str:
+        """The newest journaled ``slo_breach`` row's trace id across
+        the worker journals — the join key a ``scale_up`` row carries
+        back to the request that tripped the burn-rate signal (""
+        when no breach was ever journaled or tracing is off)."""
+        best_ts, best = "", ""
+        for w in list(self.workers):
+            try:
+                with open(w.journal_path, "r",
+                          encoding="utf-8") as f:
+                    for line in f:
+                        if '"slo_breach"' not in line:
+                            continue
+                        try:
+                            row = json.loads(line)
+                        except ValueError:
+                            continue
+                        if row.get("event") != "slo_breach":
+                            continue
+                        ts = str(row.get("ts", ""))
+                        if ts >= best_ts:  # ISO-8601 sorts by time
+                            best_ts = ts
+                            best = str(row.get("trace_id", "") or "")
+            except OSError:
+                continue
+        return best
 
     # -------------------------------------------------- checkpointing
 
@@ -564,6 +824,7 @@ class ServeFleet:
         op = msg.get("op")
         fn = getattr(self, f"op_{op}", None)
         from yask_tpu.obs.tracer import activate, span
+        from yask_tpu.serve.api import Overloaded
         tid = self._stamp_trace(msg)
         try:
             with activate(tid), \
@@ -576,6 +837,12 @@ class ServeFleet:
                     out = self._forward(msg, emit)
                 else:
                     out = {"ok": False, "error": f"unknown op {op!r}"}
+        except Overloaded as e:
+            # structured rejection: clients key on "overloaded" and
+            # honor the Retry-After hint, no error-string parsing
+            out = {"ok": False, "error": f"Overloaded: {e}",
+                   "overloaded": True,
+                   "retry_after": float(e.retry_after)}
         except Exception as e:  # noqa: BLE001 - the front must answer
             out = {"ok": False, "error": f"{type(e).__name__}: {e}"}
         if "id" in msg:
@@ -589,7 +856,10 @@ class ServeFleet:
         if msg.get("op") == "run":
             self._maybe_snapshot_before_run(sid)
         out = self._call_with_failover(msg, emit, sids=(sid,))
-        if out.get("ok"):
+        # anomaly runs DID execute (sanity quarantined the outputs but
+        # worker state advanced) — they must enter the replay log or a
+        # later failover restores a state missing those steps.
+        if out.get("ok") or out.get("status") == "anomaly":
             self._note_ok(sid, msg)
         return out
 
@@ -605,8 +875,7 @@ class ServeFleet:
         try:
             return self._worker_call(w, msg, emit)
         except (ServeClientError, OSError) as e:
-            with self._lock:
-                replaced = self.workers[w.idx] is not w
+            replaced = self._worker_at(w.idx) is not w
             if not replaced and w.alive():
                 raise  # the worker answered; not a death
             self._failover(w, cause=e)
@@ -635,7 +904,19 @@ class ServeFleet:
                 except Exception:  # noqa: BLE001 - beacon only
                     pass
         fields = {k: v for k, v in msg.items() if k not in ("op", "id")}
-        return w.call(msg["op"], on_stream=hook, **fields)
+        try:
+            return w.call(msg["op"], on_stream=hook, **fields)
+        except ServeClientError as e:
+            resp = getattr(e, "response", None)
+            if isinstance(resp, dict):
+                # the worker ANSWERED ok:false (rejected / anomaly /
+                # app error): pass the STRUCTURED response through —
+                # clients key on status/anomaly fields, and failover
+                # must never re-run an op a live worker executed.
+                out = dict(resp)
+                out.pop("id", None)  # handle() re-stamps ours
+                return out
+            raise
 
     def op_open(self, msg, emit=None):
         w = self._admit()
@@ -650,8 +931,14 @@ class ServeFleet:
         try:
             out = w.call("open", **fields)
         except (ServeClientError, OSError) as e:
-            with self._lock:
-                replaced = self.workers[w.idx] is not w
+            resp = getattr(e, "response", None)
+            if isinstance(resp, dict) and resp.get("overloaded"):
+                # worker-level brownout (tier 2): the structured
+                # rejection + Retry-After hint rides through the fleet
+                out2 = dict(resp)
+                out2.pop("id", None)
+                return out2
+            replaced = self._worker_at(w.idx) is not w
             if not replaced and w.alive():
                 raise
             self._failover(w, cause=e)
@@ -762,10 +1049,15 @@ class ServeFleet:
                 row["slo"] = {"error": f"{type(e).__name__}: {e}"}
             rows.append(row)
         out = {"ok": True, "cache_dir": self.cache_dir,
-               "slo_breaches": slo_breaches, "workers": rows}
+               "slo_breaches": slo_breaches, "workers": rows,
+               "autoscale": self._autoscaler is not None,
+               "draining": [w.idx for w in self.workers
+                            if w.draining]}
         with self._lock:
             if self._telemetry is not None:
                 out["telemetry_ts"] = self._telemetry.get("ts")
+                out["stale_workers"] = list(
+                    self._telemetry.get("stale_workers") or [])
         return out
 
     def op_metrics_snapshot(self, msg, emit=None):
@@ -854,6 +1146,11 @@ def main(argv=None) -> int:
                     help="heartbeat supervision interval; 0 disables "
                          "the background health loop "
                          "(YT_FLEET_HB_SECS overrides when unset)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="enable the SLO-driven autoscaler "
+                         "(YT_FLEET_MIN/MAX_WORKERS bounds, "
+                         "YT_FLEET_SCALE_* thresholds; also "
+                         "switchable via YT_FLEET_AUTOSCALE=1)")
     args = ap.parse_args(argv)
 
     wargs: List[str] = []
@@ -868,7 +1165,8 @@ def main(argv=None) -> int:
                        cache_dir=args.cache_dir,
                        journal_dir=args.journal_dir,
                        worker_args=wargs,
-                       hb_secs=args.hb_secs)
+                       hb_secs=args.hb_secs,
+                       autoscale=True if args.autoscale else None)
     try:
         if args.port is not None:
             import socket

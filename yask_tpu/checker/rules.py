@@ -65,7 +65,8 @@ CKPT: Tuple[str, ...] = ("CKPT-DIR", "CKPT-CADENCE", "CKPT-DEADLINE",
                          "CKPT-LADDER")
 
 SERVE: Tuple[str, ...] = ("SERVE-BATCH-INCOMPAT",
-                          "SERVE-BUCKET-INELIGIBLE", "SERVE-CACHE-COLD")
+                          "SERVE-BUCKET-INELIGIBLE", "SERVE-CACHE-COLD",
+                          "SERVE-AUTOSCALE-BOUNDS")
 
 PIPELINE: Tuple[str, ...] = ("PIPELINE-SKIPPED", "PIPELINE-INFEASIBLE",
                              "PIPELINE-VMEM-SPILL", "PIPELINE-ENGAGED")

@@ -31,6 +31,13 @@ Rules (catalog in ``docs/checking.md``):
   restarted server answers its first request with zero lowerings),
   and without the disk cache every restart re-traces and re-lowers
   every profile (warn).
+* ``SERVE-AUTOSCALE-BOUNDS`` — the fleet autoscaler is enabled
+  (``YT_FLEET_AUTOSCALE``) with incoherent knobs:
+  ``YT_FLEET_MIN_WORKERS`` above ``YT_FLEET_MAX_WORKERS`` (error —
+  the policy clamps, but the operator asked for an impossible fleet),
+  a zero ``YT_FLEET_SCALE_COOLDOWN`` (warn — nothing damps
+  up/down flapping but the idle-tick counter), or both scale-up
+  triggers disabled (warn — the fleet can only ever shrink).
 
 Pure host work: a mode property, an equation scan, and an environment
 read — no plan, no execution.
@@ -102,3 +109,49 @@ def check_serve(report: CheckReport, ctx) -> None:
                    "profile instead of answering its first request "
                    "from the disk cache with zero lowerings",
                    detail={"env": "YT_COMPILE_CACHE"})
+
+    from yask_tpu.serve.autoscale import (fleet_autoscale_enabled,
+                                          fleet_max_workers,
+                                          fleet_min_workers,
+                                          fleet_scale_cooldown,
+                                          fleet_scale_up_burn,
+                                          fleet_scale_up_queue)
+    if fleet_autoscale_enabled():
+        lo, hi = fleet_min_workers(), fleet_max_workers()
+        # the accessors clamp (max floors at min) — read the raw env
+        # to catch the operator asking for an impossible fleet
+        import os
+        try:
+            raw_hi = int(float(os.environ.get(
+                "YT_FLEET_MAX_WORKERS", "") or hi))
+        except ValueError:
+            raw_hi = hi
+        knobs = {"min_workers": lo, "max_workers": hi,
+                 "cooldown_secs": fleet_scale_cooldown(),
+                 "up_queue": fleet_scale_up_queue(),
+                 "up_burn": fleet_scale_up_burn()}
+        if raw_hi < lo:
+            report.add("SERVE-AUTOSCALE-BOUNDS", "error",
+                       f"YT_FLEET_MIN_WORKERS={lo} exceeds "
+                       f"YT_FLEET_MAX_WORKERS={raw_hi}: the policy "
+                       "clamps max up to min, but the operator asked "
+                       "for an impossible fleet",
+                       detail={**knobs, "raw_max_workers": raw_hi})
+        elif fleet_scale_cooldown() == 0.0:
+            report.add("SERVE-AUTOSCALE-BOUNDS", "warn",
+                       "YT_FLEET_SCALE_COOLDOWN=0: nothing damps "
+                       "up/down flapping but the idle-tick counter",
+                       detail=knobs)
+        elif fleet_scale_up_queue() == 0 and fleet_scale_up_burn() == 0:
+            report.add("SERVE-AUTOSCALE-BOUNDS", "warn",
+                       "both scale-up triggers disabled "
+                       "(YT_FLEET_SCALE_UP_QUEUE=0 and "
+                       "YT_FLEET_SCALE_UP_BURN=0): the fleet can only "
+                       "ever shrink",
+                       detail=knobs)
+        else:
+            report.add("SERVE-AUTOSCALE-BOUNDS", "info",
+                       f"autoscaler bounds coherent: "
+                       f"[{lo}, {hi}] workers, cooldown "
+                       f"{fleet_scale_cooldown():g}s",
+                       detail=knobs)

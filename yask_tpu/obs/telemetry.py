@@ -41,7 +41,8 @@ STABLE_COUNTERS = (
     "serve.degraded",
     "serve.preempted",
 )
-STABLE_COUNTER_PREFIXES = ("serve.requests.", "serve.cache.")
+STABLE_COUNTER_PREFIXES = ("serve.requests.", "serve.cache.",
+                           "serve.overload.")
 STABLE_GAUGES = ("serve.queue_depth",)
 STABLE_HISTOGRAMS = (
     "serve.queue_ms",
@@ -80,19 +81,31 @@ def merge_snapshots(per_worker: Dict[str, Dict],
     keys (``occupancy``, ``cache``, ``journal``, ``slo``) ride along in
     the ``workers`` block untouched.  The ``merged`` block sums counters
     and gauges and pools histogram samples (see module doc).
+
+    A snapshot carrying ``stale: True`` (the fleet front stamps it when
+    a worker has not answered a poll for 3 heartbeat intervals — it is
+    the LAST known snapshot, not a fresh one) keeps its ``workers``
+    block entry for inspection but is EXCLUDED from the merged fold,
+    and its worker id lands in the top-level ``stale_workers`` list:
+    a hung worker's dead numbers must not ride in fleet sums forever,
+    and the autoscaler must be able to refuse to act on them.
     """
     counters: Dict[str, int] = {}
     gauges: Dict[str, float] = {}
     hists: Dict[str, List[Dict]] = {}
     workers: Dict[str, Dict] = {}
+    stale: List[str] = []
     for wid, snap in sorted(per_worker.items()):
         snap = snap or {}
-        for k, v in (snap.get("counters") or {}).items():
-            counters[k] = counters.get(k, 0) + int(v)
-        for k, v in (snap.get("gauges") or {}).items():
-            gauges[k] = gauges.get(k, 0.0) + float(v)
-        for k, s in (snap.get("histograms") or {}).items():
-            hists.setdefault(k, []).append(s)
+        if snap.get("stale"):
+            stale.append(str(wid))
+        else:
+            for k, v in (snap.get("counters") or {}).items():
+                counters[k] = counters.get(k, 0) + int(v)
+            for k, v in (snap.get("gauges") or {}).items():
+                gauges[k] = gauges.get(k, 0.0) + float(v)
+            for k, s in (snap.get("histograms") or {}).items():
+                hists.setdefault(k, []).append(s)
         # per-worker view without the raw windows (they can be large)
         wsnap = dict(snap)
         wsnap["histograms"] = {
@@ -101,6 +114,7 @@ def merge_snapshots(per_worker: Dict[str, Dict],
         workers[str(wid)] = wsnap
     out = {"v": TELEMETRY_SCHEMA,
            "workers": workers,
+           "stale_workers": stale,
            "merged": {
                "counters": dict(sorted(counters.items())),
                "gauges": dict(sorted(gauges.items())),

@@ -270,7 +270,8 @@ _SITE_PHASES = (("ckpt.", "checkpoint"), ("cache.", "compile"),
                 ("serve.", "compute"), ("run.", "compute"),
                 ("bench.", "compute"), ("session.", "compute"),
                 ("multihost.", "compute"), ("pipeline.", "compute"),
-                ("suite.", "compute"), ("watch.", "front"))
+                ("suite.", "compute"), ("watch.", "front"),
+                ("load.", "front"))
 
 
 def phase_for_site(site: str) -> str:

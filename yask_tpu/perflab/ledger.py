@@ -42,7 +42,7 @@ LEDGER_BASENAME = "PERF_LEDGER.jsonl"
 #: can enumerate them.
 KNOWN_SOURCES = ("bench", "suite", "harness", "tpu_session", "multichip",
                  "bisect", "perfcheck", "test", "bench_seed",
-                 "attribution")
+                 "attribution", "load")
 
 _REQUIRED = ("v", "key", "value", "unit", "platform", "source",
              "measured_at", "provenance")

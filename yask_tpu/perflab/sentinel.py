@@ -182,6 +182,22 @@ SERVE_BUCKET_SPEEDUP_FLOOR = 1.5
 #: hardware once tpu_session banks the pipeline_fusion_ab stage.
 PIPELINE_FUSION_FLOOR = 1.2
 
+#: PROVISIONAL floor for the load harness's goodput fraction
+#: (tools/load_harness.py ``load-goodput``: completed-ok responses /
+#: offered requests on a seeded open-loop run, unit "x" so the
+#: sentinel guards it).  The harness's deterministic --check scenario
+#: offers load a 1–2 worker CPU fleet can absorb after scale-up, so a
+#: healthy run completes (nearly) everything: deadline fast-fails,
+#: brownout session rejections, and saturation errors all subtract
+#: from goodput, which is exactly the failure class this guards — an
+#: overload-control bug silently rejecting admissible traffic, or an
+#: autoscaler that stops responding to pressure.  0.9 tolerates a
+#: straggler request dying at harness shutdown while flagging any
+#: systematic shedding.  CPU-scoped; chaos-soak runs (injected kills/
+#: hangs/corruption lower goodput BY DESIGN) bank with distinct
+#: ``load-soak`` keys that this pattern does not match.
+LOAD_GOODPUT_FLOOR = 0.9
+
 DEFAULT_RULES: List[GuardRule] = [
     GuardRule(name="iso3dfd-128-jit-floor",
               pattern="128^3 fp32 cpu throughput",
@@ -216,6 +232,10 @@ DEFAULT_RULES: List[GuardRule] = [
     GuardRule(name="pipeline-fusion-floor",
               pattern="pipeline-fusion",
               floor=PIPELINE_FUSION_FLOOR, rel_tol=0.25,
+              platforms=("cpu",)),
+    GuardRule(name="load-goodput-floor",
+              pattern="load-goodput",
+              floor=LOAD_GOODPUT_FLOOR, rel_tol=0.25,
               platforms=("cpu",)),
     # the backstop every throughput/speedup row gets: trailing clean
     # median, generous tolerance (CPU-proxy trial noise is real)

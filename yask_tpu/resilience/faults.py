@@ -41,7 +41,8 @@ from typing import Dict, List, Optional
 
 __all__ = [
     "Fault", "RelayDown", "DeviceHang", "CompilerOOM", "CompileFailed",
-    "ResultAnomaly", "WorkerDead", "WorkerUnhealthy", "FAULT_KINDS",
+    "ResultAnomaly", "WorkerDead", "WorkerUnhealthy", "LoadSpike",
+    "FAULT_KINDS",
     "classify", "classify_message", "Breaker", "default_breaker_path",
     "fault_point", "maybe_corrupt", "reset_faults", "active_plan",
 ]
@@ -109,9 +110,19 @@ class WorkerUnhealthy(Fault):
     kind = "worker_unhealthy"
 
 
+class LoadSpike(Fault):
+    """An injected traffic burst: the load harness probes
+    ``fault_point("load.arrival")`` before each open-loop arrival and
+    answers a raised LoadSpike with an immediate burst of extra
+    requests.  Unlike the device faults this is demand-side chaos —
+    nothing is broken, the offered load just jumped — so it is never
+    retryable and never feeds the outage breaker."""
+    kind = "load_spike"
+
+
 FAULT_KINDS = {cls.kind: cls for cls in
                (RelayDown, DeviceHang, CompilerOOM, CompileFailed,
-                ResultAnomaly, WorkerDead, WorkerUnhealthy)}
+                ResultAnomaly, WorkerDead, WorkerUnhealthy, LoadSpike)}
 
 # Message signatures, most specific first.  A Mosaic OOM message also
 # matches the INTERNAL/compile signs, so the OOM test must win (the
@@ -348,6 +359,8 @@ def fault_point(site: str) -> None:
     if kind == "worker_unhealthy":
         raise WorkerUnhealthy(f"injected unhealthy worker at {site}",
                               site=site)
+    if kind == "load_spike":
+        raise LoadSpike(f"injected load spike at {site}", site=site)
 
 
 def maybe_corrupt(site: str, value):

@@ -26,6 +26,17 @@ Env knobs (all optional; see ``docs/serving.md``):
 * ``YT_SERVE_BUCKETING``  — "0" disables shape-bucket co-batching at
   ``open_session`` (default on; see ``yask_tpu/serve/buckets.py``);
 * ``YT_SERVE_BUCKETS``    — bucket-ladder rung override (buckets.py).
+
+Overload-control knobs (brownout tiers; ALL default off so an
+unconfigured server sheds nothing — see docs/serving.md):
+
+* ``YT_SERVE_SHED_BURN``   — max short-window SLO burn rate at/above
+  which the scheduler enters tier 1 (shed streaming flushes);
+* ``YT_SERVE_REJECT_BURN`` — burn rate for tier 2 (also reject NEW
+  sessions with :class:`Overloaded` + a Retry-After hint);
+* ``YT_SERVE_SHED_QUEUE`` / ``YT_SERVE_REJECT_QUEUE`` — queue-depth
+  fallbacks for the same tiers, for servers without an SLO monitor;
+* ``YT_SERVE_RETRY_AFTER`` — the Retry-After hint, seconds (1.0).
 """
 
 from __future__ import annotations
@@ -64,6 +75,48 @@ def serve_max_batch() -> int:
 def serve_deadline_secs() -> float:
     return max(0.0, _env_float("YT_SERVE_DEADLINE",
                                DEFAULT_DEADLINE_SECS))
+
+
+def serve_shed_burn() -> float:
+    """Tier-1 brownout threshold on the max short-window SLO burn rate
+    (``YT_SERVE_SHED_BURN``; 0 = tier never engages via burn)."""
+    return max(0.0, _env_float("YT_SERVE_SHED_BURN", 0.0))
+
+
+def serve_reject_burn() -> float:
+    """Tier-2 brownout threshold (``YT_SERVE_REJECT_BURN``; 0 = off)."""
+    return max(0.0, _env_float("YT_SERVE_REJECT_BURN", 0.0))
+
+
+def serve_shed_queue() -> int:
+    """Tier-1 queue-depth fallback (``YT_SERVE_SHED_QUEUE``; 0 = off)
+    for servers running without an SLO monitor."""
+    return max(0, int(_env_float("YT_SERVE_SHED_QUEUE", 0)))
+
+
+def serve_reject_queue() -> int:
+    """Tier-2 queue-depth fallback (``YT_SERVE_REJECT_QUEUE``; 0=off)."""
+    return max(0, int(_env_float("YT_SERVE_REJECT_QUEUE", 0)))
+
+
+def serve_retry_after() -> float:
+    """The Retry-After hint carried by :class:`Overloaded`
+    (``YT_SERVE_RETRY_AFTER``, seconds, default 1.0)."""
+    return max(0.0, _env_float("YT_SERVE_RETRY_AFTER", 1.0))
+
+
+class Overloaded(RuntimeError):
+    """Structured overload rejection: brownout tier 2 is refusing NEW
+    sessions (or the fleet front is saturated).  Carries a Retry-After
+    hint so a well-behaved client can back off instead of hammering;
+    in-flight work is NEVER answered with this — admission is the only
+    place it is raised."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0,
+                 tier: int = 2):
+        super().__init__(msg)
+        self.retry_after = float(retry_after)
+        self.tier = int(tier)
 
 
 def serve_bucketing_enabled() -> bool:

@@ -1,0 +1,254 @@
+"""SLO-driven fleet autoscaling policy (the DECISION side only).
+
+The fleet front (``tools/serve_fleet.py``) owns the mechanism — warm
+spawn from the shared compile cache, drain + migrate via the
+checkpoint/failover path — and calls :meth:`AutoscalePolicy.decide`
+once per supervision tick with a :class:`ScaleSignals` built from the
+SAME merged telemetry snapshot ``fleet_stats`` answers from.  Keeping
+the policy a pure function of (signals, clock) makes every threshold,
+the cooldown, and the min/max bounds unit-testable without a fleet.
+
+Signals (see :func:`signals_from_snapshot`):
+
+* **queue depth** — summed over FRESH per-worker blocks only;
+* **SLO burn rate** — the max shortest-window burn across fresh
+  workers' ``slo.burn`` summaries (0.0 when no worker runs a
+  monitor);
+* **staleness** — workers whose snapshot block is older than the
+  exclusion horizon (``merge_snapshots`` flags them).  A tick with
+  ZERO fresh workers yields NO decision: the autoscaler must not
+  scale on dead data.
+
+Policy: scale UP one worker when the per-fresh-worker queue depth
+reaches ``YT_FLEET_SCALE_UP_QUEUE`` or the burn rate reaches
+``YT_FLEET_SCALE_UP_BURN``; scale DOWN one worker after
+``YT_FLEET_SCALE_DOWN_IDLE`` consecutive fully-idle ticks.  Both are
+bounded by ``YT_FLEET_MIN_WORKERS`` / ``YT_FLEET_MAX_WORKERS`` and a
+shared ``YT_FLEET_SCALE_COOLDOWN`` so the loop cannot flap — a
+decision (either direction) opens the cooldown window and nothing
+else fires inside it.  Every decision carries the triggering signal
+values; the fleet journals them on the ``scale_up`` / ``scale_down``
+rows (docs/serving.md has the policy table).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ScaleSignals", "Decision", "AutoscalePolicy",
+           "signals_from_snapshot", "fleet_autoscale_enabled",
+           "fleet_min_workers", "fleet_max_workers",
+           "fleet_scale_cooldown", "fleet_scale_up_queue",
+           "fleet_scale_up_burn", "fleet_scale_down_idle"]
+
+
+def _env_num(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def fleet_autoscale_enabled() -> bool:
+    """``YT_FLEET_AUTOSCALE`` master switch (default OFF — a fleet
+    without the knob never changes size on its own)."""
+    return os.environ.get("YT_FLEET_AUTOSCALE", "").strip().lower() \
+        in ("1", "on", "true", "yes")
+
+
+def fleet_min_workers() -> int:
+    """``YT_FLEET_MIN_WORKERS`` (default 1): scale-down floor."""
+    return max(1, int(_env_num("YT_FLEET_MIN_WORKERS", 1)))
+
+
+def fleet_max_workers() -> int:
+    """``YT_FLEET_MAX_WORKERS`` (default 4): scale-up ceiling."""
+    return max(1, int(_env_num("YT_FLEET_MAX_WORKERS", 4)))
+
+
+def fleet_scale_cooldown() -> float:
+    """``YT_FLEET_SCALE_COOLDOWN`` seconds (default 30): after ANY
+    scaling decision, no further decision fires until it elapses."""
+    return max(0.0, _env_num("YT_FLEET_SCALE_COOLDOWN", 30.0))
+
+
+def fleet_scale_up_queue() -> int:
+    """``YT_FLEET_SCALE_UP_QUEUE`` (default 8): per-fresh-worker queue
+    depth at/above which the fleet scales up (0 disables the queue
+    trigger)."""
+    return max(0, int(_env_num("YT_FLEET_SCALE_UP_QUEUE", 8)))
+
+
+def fleet_scale_up_burn() -> float:
+    """``YT_FLEET_SCALE_UP_BURN`` (default 1.0): max shortest-window
+    SLO burn rate at/above which the fleet scales up (0 disables the
+    burn trigger; 1.0 = consuming the whole error budget)."""
+    return max(0.0, _env_num("YT_FLEET_SCALE_UP_BURN", 1.0))
+
+
+def fleet_scale_down_idle() -> int:
+    """``YT_FLEET_SCALE_DOWN_IDLE`` (default 3): consecutive
+    fully-idle supervision ticks (zero queued work fleet-wide) before
+    one worker drains and retires."""
+    return max(1, int(_env_num("YT_FLEET_SCALE_DOWN_IDLE", 3)))
+
+
+@dataclass
+class ScaleSignals:
+    """One tick's observation — everything :meth:`decide` may read."""
+    n_workers: int = 0
+    #: workers already draining (still in ``n_workers``; excluded from
+    #: the scale-down headroom so one idle stretch retires one worker).
+    n_draining: int = 0
+    #: workers whose telemetry block was polled fresh this tick (or is
+    #: younger than the staleness horizon).
+    fresh_workers: int = 0
+    stale_workers: List[str] = field(default_factory=list)
+    #: summed queue depth over FRESH workers only.
+    queue_depth: int = 0
+    #: max shortest-window SLO burn across fresh workers (0.0 = no
+    #: monitor anywhere, or every window still empty).
+    max_burn: float = 0.0
+
+    def detail(self) -> Dict:
+        """The journal-row form (scale_up/scale_down ``detail.signal``)."""
+        return {"n_workers": self.n_workers,
+                "n_draining": self.n_draining,
+                "fresh_workers": self.fresh_workers,
+                "stale_workers": list(self.stale_workers),
+                "queue_depth": self.queue_depth,
+                "max_burn": round(float(self.max_burn), 4)}
+
+
+@dataclass
+class Decision:
+    """One scaling decision: ``action`` is ``"up"`` or ``"down"``,
+    ``reason`` names the trigger (``queue_depth`` / ``burn_rate`` /
+    ``idle``), ``signal`` is the triggering :class:`ScaleSignals`
+    detail dict journaled with the row."""
+    action: str
+    reason: str
+    signal: Dict
+
+
+def _max_shortest_window_burn(slo_summary: Optional[Dict]) -> float:
+    """Max burn over every SLI's SHORTEST populated window in one
+    worker's ``metrics_snapshot()["slo"]`` summary (the same shape
+    :meth:`yask_tpu.obs.slo.SloMonitor.summary` exports)."""
+    if not isinstance(slo_summary, dict):
+        return 0.0
+    best = 0.0
+    for sli in (slo_summary.get("burn") or {}).values():
+        wins = (sli or {}).get("windows") or {}
+        keyed = []
+        for k, v in wins.items():
+            try:
+                keyed.append((float(k), v))
+            except (TypeError, ValueError):
+                continue
+        for _w, v in sorted(keyed):
+            if int((v or {}).get("total", 0)) > 0:
+                best = max(best, float((v or {}).get("burn", 0.0)))
+                break  # shortest populated window only
+    return best
+
+
+def signals_from_snapshot(merged: Optional[Dict], n_workers: int,
+                          n_draining: int = 0) -> ScaleSignals:
+    """Build one tick's :class:`ScaleSignals` from the fleet's merged
+    telemetry snapshot (``merge_snapshots`` output: per-worker blocks
+    under ``workers``, stale ones listed in ``stale_workers`` and
+    already excluded from the merged fold)."""
+    sig = ScaleSignals(n_workers=int(n_workers),
+                       n_draining=int(n_draining))
+    if not isinstance(merged, dict):
+        return sig
+    stale = [str(s) for s in (merged.get("stale_workers") or [])]
+    sig.stale_workers = stale
+    for wid, snap in (merged.get("workers") or {}).items():
+        if not isinstance(snap, dict) or wid in stale \
+                or snap.get("error"):
+            continue
+        sig.fresh_workers += 1
+        occ = snap.get("occupancy") or {}
+        try:
+            sig.queue_depth += int(occ.get("queue_depth", 0))
+        except (TypeError, ValueError):
+            pass
+        sig.max_burn = max(sig.max_burn,
+                           _max_shortest_window_burn(snap.get("slo")))
+    return sig
+
+
+class AutoscalePolicy:
+    """The pure decision loop.  Stateful only in the ways the policy
+    needs (last-decision timestamp for the cooldown, consecutive-idle
+    counter); ``clock`` is injectable so tests never sleep."""
+
+    def __init__(self, min_workers: Optional[int] = None,
+                 max_workers: Optional[int] = None,
+                 cooldown: Optional[float] = None,
+                 up_queue: Optional[int] = None,
+                 up_burn: Optional[float] = None,
+                 down_idle: Optional[int] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        import time
+        self.min_workers = fleet_min_workers() \
+            if min_workers is None else max(1, int(min_workers))
+        self.max_workers = fleet_max_workers() \
+            if max_workers is None else max(1, int(max_workers))
+        if self.max_workers < self.min_workers:
+            self.max_workers = self.min_workers
+        self.cooldown = fleet_scale_cooldown() \
+            if cooldown is None else max(0.0, float(cooldown))
+        self.up_queue = fleet_scale_up_queue() \
+            if up_queue is None else max(0, int(up_queue))
+        self.up_burn = fleet_scale_up_burn() \
+            if up_burn is None else max(0.0, float(up_burn))
+        self.down_idle = fleet_scale_down_idle() \
+            if down_idle is None else max(1, int(down_idle))
+        self._clock = clock or time.monotonic
+        self._last_decision_ts: Optional[float] = None
+        self._idle_ticks = 0
+
+    @classmethod
+    def from_env(cls) -> "AutoscalePolicy":
+        return cls()
+
+    def _in_cooldown(self, now: float) -> bool:
+        return self._last_decision_ts is not None \
+            and (now - self._last_decision_ts) < self.cooldown
+
+    def decide(self, sig: ScaleSignals) -> Optional[Decision]:
+        """One tick: at most one Decision, or None (hold)."""
+        if sig.fresh_workers <= 0:
+            # dead data: every worker's block is stale or missing —
+            # refuse to decide anything (and do not count the tick as
+            # idle; an unobserved fleet is not a quiet one).
+            self._idle_ticks = 0
+            return None
+        now = self._clock()
+        per_q = sig.queue_depth / max(1, sig.fresh_workers)
+        hot_q = self.up_queue > 0 and per_q >= self.up_queue
+        hot_b = self.up_burn > 0 and sig.max_burn >= self.up_burn
+        if hot_q or hot_b:
+            self._idle_ticks = 0
+            if sig.n_workers >= self.max_workers \
+                    or self._in_cooldown(now):
+                return None
+            self._last_decision_ts = now
+            reason = "queue_depth" if hot_q else "burn_rate"
+            return Decision("up", reason, sig.detail())
+        if sig.queue_depth == 0:
+            self._idle_ticks += 1
+        else:
+            self._idle_ticks = 0
+        if self._idle_ticks >= self.down_idle \
+                and (sig.n_workers - sig.n_draining) > self.min_workers \
+                and not self._in_cooldown(now):
+            self._idle_ticks = 0
+            self._last_decision_ts = now
+            return Decision("down", "idle", sig.detail())
+        return None

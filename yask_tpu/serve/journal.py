@@ -7,7 +7,8 @@ Schema ``yask_tpu.serve/1`` — one row per request-lifecycle event::
      "session": "tenant-3",
      "event":   "received|batched|ok|anomaly|rejected|fault|degraded"
                 "|stream|preempted|worker_dead|failover|retry"
-                "|snapshot",
+                "|snapshot|slo_breach|scale_up|scale_down|drain"
+                "|shed|overloaded",
      "ts":      "2026-08-05T12:00:00Z",
      "detail":  {...}}                 # event-specific (batch size,
                                        # fault kind, ladder rung, ...)
@@ -52,7 +53,20 @@ SERVE_EVENTS = ("received", "batched", "ok", "anomaly", "rejected",
                 # slo_breach = the LOG-ONLY SLO monitor saw every
                 # burn-rate window above threshold (detail: signal,
                 # budget, per-window burn; trace_id = worst offender).
-                "slo_breach")
+                "slo_breach",
+                # elastic-fleet lifecycle (front-side journal):
+                # scale_up = the autoscaler warm-spawned a worker
+                # (detail: worker idx, triggering signal; trace_id =
+                # the breach/request that tripped it), drain = a
+                # worker stopped admitting ahead of retirement
+                # (detail: sessions to migrate), scale_down = the
+                # drained worker was retired (detail: migrated/lost
+                # session ids).  shed = a brownout tier dropped a
+                # streaming flush (detail: tier), overloaded = a new
+                # session was rejected with a Retry-After hint
+                # (detail: tier, retry_after).
+                "scale_up", "scale_down", "drain", "shed",
+                "overloaded")
 
 
 def _repo_root() -> str:

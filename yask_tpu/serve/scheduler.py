@@ -163,6 +163,9 @@ class BatchScheduler:
         # the unconfigured path must cost nothing and write nothing)
         from yask_tpu.obs.slo import SloMonitor
         self._slo = SloMonitor.from_env()
+        # brownout tier cache: (monotonic ts, tier) — overload_tier()
+        # is probed per flush and per open, so it must stay cheap
+        self._tier_cache: Optional[Tuple[float, int]] = None
         self._shutdown = False
         self._next_rid = 0
         self._samples: List[Dict] = []
@@ -230,6 +233,62 @@ class BatchScheduler:
             return self._slo.summary()
         except Exception:  # noqa: BLE001 - surfacing must never raise
             return None
+
+    def _max_burn(self) -> float:
+        """Max SLO burn rate over the SHORTEST evaluation window (fast
+        detection is the point of a brownout) across SLIs with events.
+        0.0 without a monitor — the queue-depth fallbacks take over."""
+        if self._slo is None:
+            return 0.0
+        try:
+            rates = self._slo.burn_rates()
+        except Exception:  # noqa: BLE001 - observability never breaks
+            return 0.0     # serving
+        best = 0.0
+        for r in rates.values():
+            wins = r.get("windows") or {}
+            if not wins:
+                continue
+            w = wins[min(wins, key=lambda k: int(k))]
+            if int(w.get("total", 0)) > 0:
+                best = max(best, float(w.get("burn", 0.0)))
+        return best
+
+    def overload_tier(self, now: Optional[float] = None) -> int:
+        """The brownout tier: 0 = normal, 1 = shed streaming flushes,
+        2 = also reject NEW sessions (``Overloaded`` + Retry-After).
+        Driven by the SLO burn signal (``YT_SERVE_SHED_BURN`` /
+        ``YT_SERVE_REJECT_BURN``) with queue-depth fallbacks
+        (``YT_SERVE_SHED_QUEUE`` / ``YT_SERVE_REJECT_QUEUE``) for
+        SLO-less servers; every knob defaults off, so an unconfigured
+        scheduler never sheds.  In-flight work is never abandoned by
+        any tier — tier 1 drops progress beacons, tier 2 refuses
+        admission, nothing touches running requests.  Cached ~250 ms:
+        this is probed per flush and per open."""
+        from yask_tpu.serve.api import (serve_reject_burn,
+                                        serve_reject_queue,
+                                        serve_shed_burn,
+                                        serve_shed_queue)
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            if self._tier_cache is not None \
+                    and now - self._tier_cache[0] < 0.25:
+                return self._tier_cache[1]
+        shed_b, rej_b = serve_shed_burn(), serve_reject_burn()
+        shed_q, rej_q = serve_shed_queue(), serve_reject_queue()
+        tier = 0
+        if shed_b or rej_b or shed_q or rej_q:
+            burn = self._max_burn() if (shed_b or rej_b) else 0.0
+            depth = self.queue_depth()
+            if (rej_b and burn >= rej_b) or (rej_q and depth >= rej_q):
+                tier = 2
+            elif (shed_b and burn >= shed_b) \
+                    or (shed_q and depth >= shed_q):
+                tier = 1
+            self._obs.gauge("serve.overload.tier").set(tier)
+        with self._lock:
+            self._tier_cache = (now, tier)
+        return tier
 
     def session_ctx(self, sid: str):
         """Contextmanager: the session's prepared context with ITS
@@ -311,6 +370,7 @@ class BatchScheduler:
         occupancy cap."""
         from yask_tpu.runtime.ensemble import ensemble_feasible
         with self._cond:
+            self._expire_queued()
             if head not in self._pending:
                 return []
             key = self._batch_key(head)
@@ -336,11 +396,37 @@ class BatchScheduler:
                 self._pending.remove(p)
             return batch
 
+    def _expire_queued(self, now: Optional[float] = None) -> None:
+        """Fast-fail every pending request whose deadline elapsed while
+        still QUEUED — before the worker touches the device for it.
+        The deadline used to bound only device work; a request that
+        waited its whole budget in ``_pending`` burned it just as
+        surely, and running it anyway wastes a device turn on an
+        answer the tenant has already given up on.  Caller holds
+        ``self._cond``."""
+        now = time.perf_counter() if now is None else float(now)
+        for p in list(self._pending):
+            ddl = p.req.deadline_secs or serve_deadline_secs()
+            if ddl <= 0 or now - p.t_received <= ddl:
+                continue
+            self._pending.remove(p)
+            self._obs.counter(
+                "serve.overload.deadline_in_queue").inc()
+            p.finish(self._reject(
+                p, f"deadline {ddl:g}s expired after "
+                   f"{now - p.t_received:.3f}s in queue (request "
+                   "never reached the device)",
+                reason="deadline_in_queue"))
+
     # --------------------------------------------------------- execute
 
-    def _reject(self, p: _Pending, why: str) -> ServeResponse:
+    def _reject(self, p: _Pending, why: str,
+                reason: str = "") -> ServeResponse:
+        detail = {"error": why[:200]}
+        if reason:
+            detail["reason"] = reason
         self._journal.record(p.rid, p.req.session, "rejected",
-                             trace_id=p.trace, error=why[:200])
+                             trace_id=p.trace, **detail)
         self._obs.counter("serve.requests.rejected").inc()
         self._slo_feed(p, p.req.session, ok=False)
         return ServeResponse(rid=p.rid, session=p.req.session,
@@ -550,8 +636,18 @@ class BatchScheduler:
         evidence I/O (the journal's own policy, applied to streams)."""
         from yask_tpu.resilience.faults import Fault
         from yask_tpu.resilience.guard import guarded_call
+        tier = self.overload_tier()
         for p, sess in zip(batch, sessions):
             if p.req.flush_every <= 0:
+                continue
+            if tier >= 1:
+                # brownout tier >= 1: the progress beacon is the
+                # cheapest load to shed — the run itself (and its
+                # final answer) continues untouched
+                self._obs.counter("serve.overload.shed_flush").inc()
+                self._journal.record(p.rid, sess.sid, "shed",
+                                     trace_id=p.trace, tier=tier,
+                                     step=int(step_done))
                 continue
             try:
                 guarded_call(self._flush_one, p, sess, step_done,

@@ -87,7 +87,21 @@ class StencilServer:
         ``yask_tpu.serve.buckets`` contract); infeasible solutions
         (non-jit modes, IF_DOMAIN conditions) decline and open exact,
         with the structured reason journaled on every batched row."""
-        from yask_tpu.serve.api import serve_bucketing_enabled
+        from yask_tpu.serve.api import (Overloaded, serve_retry_after,
+                                        serve_bucketing_enabled)
+        tier = self.scheduler.overload_tier()
+        if tier >= 2:
+            # brownout tier 2: admission is the ONLY thing refused —
+            # existing sessions and in-flight requests are untouched
+            ra = serve_retry_after()
+            self.obs.counter("serve.overload.rejected_sessions").inc()
+            self.journal.record(session or "-", session or "-",
+                                "overloaded", tier=tier,
+                                retry_after=ra, stencil=str(stencil))
+            raise Overloaded(
+                f"server overloaded (brownout tier {tier}): not "
+                f"admitting new sessions; retry after {ra:g}s",
+                retry_after=ra, tier=tier)
         requested = serve_bucketing_enabled() if bucket is None \
             else bool(bucket)
         decision, sub, host_g = self._plan_bucket(
