@@ -8,7 +8,8 @@ TEST_ENV ?= PALLAS_AXON_POOL_IPS=
 .PHONY: all native capi test test-fast scratch-tests boundary-tests \
         stages-tests mode-tests bench perfcheck faultcheck commcheck \
         cachecheck servecheck obscheck telemetrycheck examples clean \
-        list-stencils lint check conformance conformance-quick loadcheck
+        list-stencils lint check conformance conformance-quick loadcheck \
+        pushcheck
 
 all: native test
 
@@ -99,11 +100,23 @@ telemetrycheck: lint
 loadcheck: lint
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) tools/load_harness.py --check
 
+# push-memory tile-graph fusion + device-resident bulk serving: the
+# eligibility oracle, pallas push bit-equality vs the host-chained
+# oracle, plan_only byte pin, PIPELINE-PUSH-* checker rules, tuner
+# push A/B, the resident-queue bit-identity/journal/fault-site
+# acceptance, and the push matrix axis (see docs/performance.md
+# "Push-memory tile-graph fusion")
+pushcheck: lint
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_pipeline.py tests/test_resident.py -q
+	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_config_matrix.py -q -k "pipeline"
+
 # static checker over the flagship configs: Mosaic legality, VMEM
 # feasibility (incl. the round-3 spill-OOM class), races, explain.
 # See docs/checking.md; nonzero exit on any error-severity finding.
 check: cachecheck servecheck obscheck telemetrycheck conformance-quick \
-       loadcheck
+       loadcheck pushcheck
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker \
 		-stencil iso3dfd -radius 8 -g 256 -mode pallas -wf_steps 2
 	$(TEST_ENV) JAX_PLATFORMS=cpu $(PY) -m yask_tpu.checker -all_stencils

@@ -212,3 +212,41 @@ def test_matrix_pipeline_fusion(env, mode, wf, radius):
     fused.run(0, 1)
     chained.run(0, 1)
     assert fused.compare(chained, epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+@pytest.mark.parametrize("push", ["on", "off", "auto"])
+@pytest.mark.parametrize("mode,wf", [("jit", 2), ("pallas", 1),
+                                     ("pallas", 2)])
+def test_matrix_pipeline_push(env, push, mode, wf):
+    # push-memory tile-graph fusion as a matrix axis: the PURE rtm
+    # chain (pushable image var) with the -push knob swept against the
+    # host-chained oracle on every mode × wf row.  Engagement is
+    # asserted where the gate must engage (pallas + on/auto) and must
+    # NOT (jit, or -push off) — a row that silently runs the wrong DMA
+    # partition cannot pass (bit/tolerance equality per schedule lives
+    # in tests/test_pipeline.py).
+    import numpy as np
+    from yask_tpu.ops.pipeline import SolutionPipeline, rtm_chain
+
+    def mk(fuse, push_cli):
+        pipe = SolutionPipeline(
+            env, *rtm_chain(radius=2, accumulate=False))
+        pipe.apply_command_line_options(
+            f"-g 16 -mode {mode} -wf_steps {wf} {push_cli}")
+        pipe.prepare(fuse=fuse)
+        v = pipe.get_var("fwd", "pressure")
+        rng = np.random.RandomState(3)
+        arr = (rng.rand(16, 16, 16).astype(np.float32) - 0.5) * 0.1
+        for t in range(v.get_first_valid_step_index(),
+                       v.get_last_valid_step_index() + 1):
+            v.set_elements_in_slice(arr, [t, 0, 0, 0],
+                                    [t, 15, 15, 15])
+        return pipe
+
+    fused = mk(True, f"-push {push}")
+    chained = mk(False, "")
+    want_push = mode == "pallas" and push in ("on", "auto")
+    assert (fused.pushed_vars() == {"img__img"}) == want_push
+    fused.run(0, 1)
+    chained.run(0, 1)
+    assert fused.compare(chained, epsilon=1e-3, abs_epsilon=1e-4) == 0

@@ -341,6 +341,46 @@ def test_ledger_to_csv(tmp_path, capsys):
     assert "abc1234" in line and "TestCPU" in line
 
 
+def test_ledger_to_csv_push_resident_columns(tmp_path, capsys):
+    # the pipeline-push and serve-resident A/B rows flatten their extra
+    # fields into dedicated columns (model B/pt, per-arm secs, achieved
+    # GB/s, occupancy); other rows leave those columns empty
+    import csv as _csv
+    import io
+    path = str(tmp_path / "ledger.jsonl")
+    guard_and_append(
+        "rtm3-pure r=2 32^3 cpu pipeline-push-speedup", 1.48, "x",
+        "cpu", "suite", _prov(),
+        extra={"push_vars": ["img__img"],
+               "hbm_bytes_model": {"chained_bytes_pp": 44.0,
+                                   "fused_bytes_pp": 20.0,
+                                   "fused_push_bytes_pp": 16.0,
+                                   "ratio": 2.2, "push_ratio": 2.75},
+               "push_secs": 0.9, "achieved_gbs_push": 1.2,
+               "achieved_gbs_fused": 1.0, "achieved_gbs_chained": 0.8},
+        path=path)
+    guard_and_append(
+        "iso3dfd r=2 16^3 cpu serve-resident-speedup", 5.6, "x",
+        "cpu", "suite", _prov(),
+        extra={"occupancy": 4, "items": 16, "resident_secs": 0.01,
+               "per_request_secs": 0.06},
+        path=path)
+    from yask_tpu.tools.log_to_csv import ledger_to_csv
+    ledger_to_csv(path)
+    rows = list(_csv.DictReader(io.StringIO(capsys.readouterr().out)))
+    push, res = rows
+    assert push["push_vars"] == '["img__img"]'
+    assert push["push_bytes_pp"] == "16.0"
+    assert push["push_ratio"] == "2.75"
+    assert push["push_secs"] == "0.9"
+    assert push["achieved_gbs_push"] == "1.2"
+    assert push["occupancy"] == "" and push["resident_secs"] == ""
+    assert res["occupancy"] == "4"
+    assert res["resident_secs"] == "0.01"
+    assert res["per_request_secs"] == "0.06"
+    assert res["push_vars"] == "" and res["push_bytes_pp"] == ""
+
+
 def test_harness_ledger_flag(tmp_path, monkeypatch, capsys):
     monkeypatch.setenv("YT_PERF_LEDGER", str(tmp_path / "led.jsonl"))
     from yask_tpu.main import run_harness

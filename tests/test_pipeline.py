@@ -22,9 +22,10 @@ def env():
     return yk_factory().new_env()
 
 
-def _mk_pipe(env, cli, fuse=None, radius=2, g=16, seed=7):
+def _mk_pipe(env, cli, fuse=None, radius=2, g=16, seed=7,
+             accumulate=True):
     from yask_tpu.ops.pipeline import SolutionPipeline, rtm_chain
-    stages, bindings = rtm_chain(radius=radius)
+    stages, bindings = rtm_chain(radius=radius, accumulate=accumulate)
     pipe = SolutionPipeline(env, stages, bindings)
     pipe.apply_command_line_options(f"-g {g} " + cli)
     pipe.prepare(fuse=fuse)
@@ -228,6 +229,178 @@ def test_checker_skips_non_pipeline_ctx(env):
     ctx.apply_command_line_options("-g 16")
     rep = run_checks(ctx, passes=["pipeline"])
     assert {d.rule for d in rep.diagnostics} == {"PIPELINE-SKIPPED"}
+
+
+# ---- push-memory tile-graph fusion ----------------------------------------
+#
+# The PURE rtm chain (rtm_img_pure: img(t+1) = fwd², no self-read)
+# makes the merged image var's only reader the smoother at +step — the
+# push flagship.  The standard (accumulating) chain's image reads
+# itself at offset 0, so push must DECLINE there.
+
+def test_push_eligible_vars_oracle(env):
+    from yask_tpu.ops.pallas_stencil import push_eligible_vars
+    pure = _mk_pipe(env, "-mode pallas -wf_steps 1", fuse=True,
+                    accumulate=False)
+    elig = push_eligible_vars(pure.fused_ctx._program)
+    assert elig["img__img"] == "ok"
+    # the final output must stay on the write-DMA path
+    assert "never read" in elig["smooth__smooth"]
+    acc = _mk_pipe(env, "-mode pallas -wf_steps 1", fuse=True)
+    acc_elig = push_eligible_vars(acc.fused_ctx._program)
+    assert "ok" not in acc_elig.values(), acc_elig
+    # the accumulating image reads itself at offset 0
+    assert "step offsets" in acc_elig["img__img"]
+
+
+def test_push_engages_on_pure_chain(env):
+    pipe = _mk_pipe(env, "-mode pallas -wf_steps 1 -push on",
+                    fuse=True, accumulate=False)
+    pal = pipe.plan()["pallas"]
+    assert pal["push"] and pal["push_vars"] == ["img__img"]
+    assert pal["push_tile_bytes"] > 0
+    assert pipe.pushed_vars() == {"img__img"}
+    codes = {r["code"] for r in pipe.plan()["reasons"]}
+    assert "pipeline-push-engaged" in codes
+    m = pipe.plan()["hbm_model"]
+    assert m["fused_push_bytes_pp"] < m["fused_bytes_pp"]
+    assert m["push_ratio"] > m["ratio"]
+
+
+def test_push_declines_on_accumulating_chain(env):
+    pipe = _mk_pipe(env, "-mode pallas -wf_steps 1 -push on", fuse=True)
+    pal = pipe.plan()["pallas"]
+    assert not pal["push"] and pipe.pushed_vars() == set()
+    codes = {r["code"] for r in pipe.plan()["reasons"]}
+    assert "pipeline-push-ineligible" in codes
+    # the decline arm still runs bit-identical to the oracle
+    chained = _mk_pipe(env, "-mode pallas -wf_steps 1", fuse=False)
+    pipe.run(0, 3)
+    chained.run(0, 3)
+    assert pipe.compare(chained) == 0
+
+
+def test_push_bitequal_chained_pallas_k1(env):
+    push = _mk_pipe(env, "-mode pallas -wf_steps 1 -push on",
+                    fuse=True, accumulate=False)
+    chained = _mk_pipe(env, "-mode pallas -wf_steps 1 -push off",
+                       fuse=False, accumulate=False)
+    push.run(0, 3)
+    chained.run(0, 3)
+    assert push.compare(chained) == 0
+
+
+def test_push_stepwise_bitequal_chunked_tolerance(env):
+    # schedule-matched K=2: push-fused driven stepwise is bit-identical
+    # to the chained oracle; the K=2 chunked schedule gates at the
+    # repo's standard temporal-chunking tolerance.
+    push = _mk_pipe(env, "-mode pallas -wf_steps 2 -push on",
+                    fuse=True, accumulate=False)
+    chained = _mk_pipe(env, "-mode pallas -wf_steps 1", fuse=False,
+                       accumulate=False)
+    for t in range(4):
+        push.run(t, t)
+    chained.run(0, 3)
+    assert push.compare(chained) == 0
+
+    chunked = _mk_pipe(env, "-mode pallas -wf_steps 2 -push on",
+                       fuse=True, accumulate=False)
+    chunked.run(0, 3)
+    assert chunked.compare(chained, epsilon=1e-3, abs_epsilon=1e-4) == 0
+
+
+def test_push_jit_bitequal_any_k(env):
+    # push is a pallas-tile concept: jit mode never pushes, stays exact
+    for wf in (1, 2):
+        fused = _mk_pipe(env, f"-mode jit -wf_steps {wf} -push on",
+                         fuse=True, accumulate=False)
+        chained = _mk_pipe(env, f"-mode jit -wf_steps {wf}",
+                           fuse=False, accumulate=False)
+        assert fused.pushed_vars() == set()
+        fused.run(0, 3)
+        chained.run(0, 3)
+        assert fused.compare(chained) == 0
+
+
+def test_push_off_keeps_var_observable(env):
+    pipe = _mk_pipe(env, "-mode pallas -wf_steps 1 -push off",
+                    fuse=True, accumulate=False)
+    assert pipe.pushed_vars() == set()
+    pipe.run(0, 1)
+    assert pipe.get_var("img", "img") is not None
+
+
+def test_get_var_raises_for_pushed(env):
+    from yask_tpu.utils.exceptions import YaskException
+    pipe = _mk_pipe(env, "-mode pallas -wf_steps 1 -push on",
+                    fuse=True, accumulate=False)
+    with pytest.raises(YaskException, match="push-fused"):
+        pipe.get_var("img", "img")
+    # the final stage's outputs stay readable
+    assert pipe.get_var("smooth", "smooth") is not None
+
+
+def test_push_bad_cli_value_raises(env):
+    # a typo'd -push must not silently resolve to auto (every other
+    # engage/decline is observable; so is a bad knob)
+    from yask_tpu.utils.exceptions import YaskException
+    with pytest.raises(YaskException, match="bad -push value"):
+        _mk_pipe(env, "-mode pallas -wf_steps 1 -push banana",
+                 fuse=True, accumulate=False)
+
+
+def test_push_plan_only_bytes_match_executed(env):
+    # plan_only=True's VMEM byte breakdown must byte-match the executed
+    # chunk's tiling — one code path, the model cannot drift (the
+    # conformance pin, extended to the push fields).  plan_pallas is
+    # the checker's mirrored plan entry (same K/block/skew/push as the
+    # runtime build).
+    from yask_tpu.checker.vmem import plan_pallas
+    pipe = _mk_pipe(env, "-mode pallas -wf_steps 1 -push on",
+                    fuse=True, accumulate=False)
+    ctx = pipe.fused_ctx
+    pplan = plan_pallas(ctx, ctx._program, ctx.vmem_budget())
+    pipe.run(0, 1)
+    tilings = [t for t in ctx._pallas_tiling.values() if t]
+    assert tilings, "pallas run recorded no tiling"
+    til = tilings[0]
+    assert til["push"] and til["push_vars"] == pplan["push_vars"]
+    assert til["push_tile_bytes"] == pplan["push_tile_bytes"] > 0
+    assert til["tile_bytes"] == pplan["tile_bytes"], (
+        f"plan_only modeled {pplan['tile_bytes']} B/tile but the "
+        f"runtime built {til['tile_bytes']} B/tile")
+
+
+def test_checker_push_rules(env):
+    from yask_tpu.checker.pipeline_pass import check_pipeline_plan
+    pure = _mk_pipe(env, "-mode pallas -wf_steps 1 -push on",
+                    fuse=True, accumulate=False)
+    rules = {d.rule for d in check_pipeline_plan(pure).diagnostics}
+    assert "PIPELINE-PUSH-ENGAGED" in rules
+    acc = _mk_pipe(env, "-mode pallas -wf_steps 1 -push on", fuse=True)
+    rules = {d.rule for d in check_pipeline_plan(acc).diagnostics}
+    assert "PIPELINE-PUSH-INFEASIBLE" in rules
+
+
+def test_tuner_push_ab_records_measurement(env):
+    from yask_tpu.runtime.auto_tuner import AutoTuner
+    pipe = _mk_pipe(env, "-mode pallas -wf_steps 1 -push on",
+                    fuse=True, accumulate=False)
+    ctx = pipe.fused_ctx
+    tuner = AutoTuner(ctx)
+    tuner.trial_secs = 0.05
+    tuner.best_rate = None
+    tuner._push_ab(1)
+    assert any(k[0] == "push" for k in tuner.results), tuner.results
+    assert ctx._opts.push_memory in ("on", "off")
+
+
+def test_tuner_push_ab_noop_when_not_engaged(env):
+    from yask_tpu.runtime.auto_tuner import AutoTuner
+    pipe = _mk_pipe(env, "-mode pallas -wf_steps 1 -push on", fuse=True)
+    tuner = AutoTuner(pipe.fused_ctx)
+    tuner._push_ab(1)   # accumulating chain: nothing engages, no arms
+    assert not any(k[0] == "push" for k in tuner.results)
 
 
 # ---- AOT cache key --------------------------------------------------------

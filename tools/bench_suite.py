@@ -995,6 +995,189 @@ def run_suite(fac, env, budget_secs=None):
         fused.end()
         chained.end()
 
+    def pipeline_push_ab():
+        # Push-memory tile-graph fusion A/B on the PURE rtm chain
+        # (rtm_img_pure: no img(t) self-read, so the merged image var's
+        # only reader is the smoother at +step — the push flagship):
+        # three arms at the same pallas K=1 temporal schedule, where
+        # the merge and the push are both bit-exact vs the host-chained
+        # oracle.  push = fused with the image tile consumed in-VMEM
+        # (no input DMA, no write-back — the var leaves HBM entirely);
+        # nopush = the r16 source-fused arm (bound reads eliminated,
+        # the image still round-trips HBM); chained = the oracle.
+        # Bit-equality gates run BEFORE and AFTER the timed windows on
+        # both fused arms.  The headline is push vs source-fused (the
+        # r16 baseline); the hbm model's chained/fused/fused_push
+        # bytes-per-point ride the row with each arm's achieved
+        # bandwidth so the modeled traffic drop is a ledger number.
+        import numpy as np
+        from yask_tpu.ops.pipeline import (SolutionPipeline, rtm_chain,
+                                           pipeline_hbm_model)
+        g = 64 if on_tpu else 32
+
+        def mk(fuse, push_cli):
+            stages, bindings = rtm_chain(radius=2, accumulate=False)
+            pipe = SolutionPipeline(env, stages, bindings)
+            pipe.apply_command_line_options(
+                f"-g {g} -mode pallas -wf_steps 1 {push_cli}")
+            pipe.prepare(fuse=fuse)
+            v = pipe.get_var("fwd", "pressure")
+            rng = np.random.RandomState(7)
+            arr = (rng.rand(g, g, g).astype(np.float32) - 0.5) * 0.1
+            for t in range(v.get_first_valid_step_index(),
+                           v.get_last_valid_step_index() + 1):
+                v.set_elements_in_slice(arr, [t, 0, 0, 0],
+                                        [t, g - 1, g - 1, g - 1])
+            return pipe
+
+        push = mk(True, "-push on")
+        nopush = mk(True, "-push off")
+        chained = mk(False, "-push off")
+        pal = (push.plan().get("pallas") or {})
+        if not pal.get("push"):
+            raise RuntimeError(
+                f"push arm did not engage: {push.plan()['reasons']}")
+
+        def gate(tag):
+            bad = push.compare(chained) + nopush.compare(chained)
+            if bad:
+                raise RuntimeError(
+                    f"push fusion not bit-identical to the "
+                    f"host-chained oracle {tag} ({bad} mismatches)")
+
+        # warmup pays trace+compile on all arms AND feeds the bit gate
+        push.run(0, steps - 1)
+        nopush.run(0, steps - 1)
+        chained.run(0, steps - 1)
+        gate("before timed windows")
+
+        def arm(pipe, lo, hi):
+            t0 = time.perf_counter()
+            pipe.run(lo, hi)
+            return time.perf_counter() - t0
+
+        t_push = t_nopush = t_chain = 0.0
+        trials = 3
+        for i in range(trials):
+            lo, hi = (i + 1) * steps, (i + 2) * steps - 1
+            t_push += arm(push, lo, hi)
+            t_nopush += arm(nopush, lo, hi)
+            t_chain += arm(chained, lo, hi)
+        gate("after timed windows")
+
+        def remeasure_ratio():
+            lo = (trials + 1) * steps
+            hi = (trials + 2) * steps - 1
+            return arm(nopush, lo, hi) / max(arm(push, lo, hi), 1e-12)
+
+        hbm = pipeline_hbm_model(push, push_vars=pal.get("push_vars"))
+        n_steps = trials * steps
+        pts = g ** 3 * n_steps
+
+        def gbs(bpp, secs):
+            return round(bpp * pts / max(secs, 1e-12) / 1e9, 3)
+
+        emit(f"rtm3-pure r=2 {g}^3 {plat} pipeline-push-speedup",
+             t_nopush / max(t_push, 1e-12), "x",
+             remeasure=remeasure_ratio,
+             criterion="push arm >= source-fused arm",
+             criterion_met=bool(t_push <= t_nopush),
+             push_vars=pal.get("push_vars"),
+             hbm_bytes_model=hbm,
+             push_secs=round(t_push, 3),
+             fused_secs=round(t_nopush, 3),
+             chained_secs=round(t_chain, 3),
+             achieved_gbs_push=gbs(hbm["fused_push_bytes_pp"], t_push),
+             achieved_gbs_fused=gbs(hbm["fused_bytes_pp"], t_nopush),
+             achieved_gbs_chained=gbs(hbm["chained_bytes_pp"], t_chain),
+             chained_over_push=round(
+                 t_chain / max(t_push, 1e-12), 4))
+        push.end()
+        nopush.end()
+        chained.end()
+
+    def serve_resident_ab():
+        # Device-resident bulk serving A/B: the SAME work list — 4
+        # sessions x 4 single-step items — drained through the
+        # resident executor (one device-lock hold, one end-of-queue
+        # sync, one extraction per session) vs per-request dispatch
+        # through the scheduler (queue + batching window + rollback
+        # snapshot + host extraction per item).  Responses bit-gated
+        # identical across arms before the row is trusted; profile is
+        # shared and pre-warmed so neither arm pays compile.
+        import numpy as np
+        from yask_tpu.serve.registry import SessionRegistry
+        from yask_tpu.serve.scheduler import BatchScheduler
+        from yask_tpu.serve.resident import run_per_request
+        g, occupancy, nsteps = 16, 4, 4
+        rng = np.random.RandomState(11)
+        arr = (rng.rand(g, g, g).astype(np.float32) - 0.5) * 0.1
+
+        reg = SessionRegistry(fac, env)
+        prof = reg.get_profile("iso3dfd", 2, str(g), mode="jit", wf=1)
+        sched = BatchScheduler(reg, window_secs=0.0)
+
+        def open_sessions():
+            sids = []
+            for i in range(occupancy):
+                s = reg.open_session(prof)
+                sids.append(s.sid)
+                with sched.session_ctx(s.sid) as ctx:
+                    v = ctx.get_var("pressure")
+                    for t in range(v.get_first_valid_step_index(),
+                                   v.get_last_valid_step_index() + 1):
+                        v.set_elements_in_slice(
+                            arr * (i + 1), [t, 0, 0, 0],
+                            [t, g - 1, g - 1, g - 1])
+            return sids
+
+        def work(sids):
+            return [(sid, t, t) for t in range(nsteps)
+                    for sid in sids]
+
+        # warm the shared profile's compile outside both timed arms
+        warm = open_sessions()
+        sched.run_resident(work(warm)[:1])
+        for sid in warm:
+            reg.close_session(sid)
+
+        sids_r = open_sessions()
+        t0 = time.perf_counter()
+        res = sched.run_resident(work(sids_r))
+        t_resident = time.perf_counter() - t0
+
+        sids_p = open_sessions()
+        t0 = time.perf_counter()
+        base = run_per_request(sched, work(sids_p))
+        t_per_req = time.perf_counter() - t0
+
+        for sr, sp in zip(sids_r, sids_p):
+            for name, a in res[sr]["outputs"].items():
+                if not np.array_equal(a, base[sp]["outputs"][name]):
+                    raise RuntimeError(
+                        f"resident arm diverged from per-request "
+                        f"dispatch on {name}")
+
+        def remeasure_ratio():
+            s1, s2 = open_sessions(), open_sessions()
+            t0 = time.perf_counter()
+            sched.run_resident(work(s1))
+            tr = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            run_per_request(sched, work(s2))
+            return (time.perf_counter() - t0) / max(tr, 1e-12)
+
+        emit(f"iso3dfd r=2 {g}^3 {plat} serve-resident-speedup",
+             t_per_req / max(t_resident, 1e-12), "x",
+             remeasure=remeasure_ratio,
+             criterion=f"resident arm strictly faster at "
+                       f"occupancy {occupancy}",
+             criterion_met=bool(t_resident < t_per_req),
+             occupancy=occupancy, items=occupancy * nsteps,
+             resident_secs=round(t_resident, 4),
+             per_request_secs=round(t_per_req, 4))
+        sched.shutdown()
+
     # explicit section(...) calls (not a loop over a tuple): repo_lint's
     # BARE-DEVICE-CALL closure sanctions device work lexically, from
     # the names passed into the guard invokers
@@ -1013,6 +1196,8 @@ def run_suite(fac, env, budget_secs=None):
     section(serve_bucket_ab, t0, budget_secs)
     section(serve_stream_ab, t0, budget_secs)
     section(pipeline_fusion_ab, t0, budget_secs)
+    section(pipeline_push_ab, t0, budget_secs)
+    section(serve_resident_ab, t0, budget_secs)
     return list(ROWS)
 
 

@@ -76,7 +76,8 @@ MATRIX = [
 
 STAGES = ("smoke", "validate", "chunk_abs", "tune_bench",
           "compile_cache_ab", "ensemble_ab", "pipeline_fusion_ab",
-          "serving", "serving_bucket", "compile_time")
+          "push_ab", "serving", "serving_bucket", "serve_resident_ab",
+          "compile_time")
 
 
 def matrix_cases():
@@ -1134,6 +1135,180 @@ def main(argv=None) -> int:
                     "anomalies": [f"pipeline-mismatch:{mismatches}"]}
         return {}
 
+    def push_ab_case():
+        """Push-memory tile-graph fusion on the real backend: the PURE
+        rtm chain (img has no self-read, so the merged image var's VMEM
+        tile is consumed in-grid-step and leaves BOTH HBM paths) with
+        push ON vs the same fused program with push OFF.  Bit gate:
+        both fused arms stepwise (K=1, exact on Mosaic) vs the
+        host-chained oracle; perf ratio then times push vs source-fused
+        at K=2 chunks — the HBM-traffic halving this stage exists to
+        measure on hardware (the CPU proxy realizes only part of it).
+        A corrupt arm is withheld from the comparison and banks
+        quarantined."""
+        from yask_tpu.ops.pipeline import (SolutionPipeline, rtm_chain,
+                                           pipeline_hbm_model)
+        gp = 128 if plat == "tpu" else 32
+        steps_p = 4
+
+        def mk(fuse, wf, push_cli):
+            stages_, bindings = rtm_chain(radius=2, accumulate=False)
+            pipe = SolutionPipeline(env, stages_, bindings)
+            pipe.apply_command_line_options(
+                f"-g {gp} -mode pallas -wf_steps {wf} {push_cli}")
+            pipe.prepare(fuse=fuse)
+            v = pipe.get_var("fwd", "pressure")
+            rng = np.random.RandomState(11)
+            arr = (rng.rand(gp, gp, gp).astype(np.float32) - 0.5) * 0.1
+            for t in range(v.get_first_valid_step_index(),
+                           v.get_last_valid_step_index() + 1):
+                v.set_elements_in_slice(arr, [t, 0, 0, 0],
+                                        [t, gp - 1, gp - 1, gp - 1])
+            return pipe
+
+        # bit-equality gate on matched stepwise schedules
+        push1, chained = mk(True, 1, "-push on"), mk(False, 1, "-push off")
+        pal = (push1.plan().get("pallas") or {})
+        if not pal.get("push"):
+            raise RuntimeError(
+                f"push did not engage on the pure chain: "
+                f"{push1.plan()['reasons']}")
+        for t in range(steps_p):
+            push1.run(t, t)
+        chained.run(0, steps_p - 1)
+        vlast = push1.get_var("smooth", "smooth")
+        sanity = check_output(
+            maybe_corrupt("session.push_result",
+                          push1._interior(
+                              "smooth", "smooth",
+                              vlast.get_last_valid_step_index())))
+        mismatches = 0
+        if sanity["ok"]:   # corrupt arm: comparison withheld
+            mismatches = int(push1.compare(chained))
+        push1.end()
+        chained.end()
+
+        # perf arms: push vs source-fused, both K=2 chunks
+        push2 = mk(True, 2, "-push on")
+        nopush2 = mk(True, 2, "-push off")
+        push2.run(0, steps_p - 1)       # warm (compile)
+        nopush2.run(0, steps_p - 1)
+        t0p = time.perf_counter()
+        push2.run(steps_p, 2 * steps_p - 1)
+        t_push = time.perf_counter() - t0p
+        t0n = time.perf_counter()
+        nopush2.run(steps_p, 2 * steps_p - 1)
+        t_nopush = time.perf_counter() - t0n
+
+        hbm = pipeline_hbm_model(push2,
+                                 push_vars=push2.pushed_vars())
+        line = {"metric": f"rtm3-pure r=2 {gp}^3 {plat} "
+                          "pipeline-push-speedup",
+                "value": round(t_nopush / max(t_push, 1e-12), 4),
+                "unit": "x", "platform": plat,
+                "push_vars": sorted(push2.pushed_vars()), "wf": 2,
+                "push_secs": round(t_push, 3),
+                "fused_secs": round(t_nopush, 3),
+                "hbm_bytes_model": hbm,
+                "mismatches": mismatches}
+        log("push_ab", **line,
+            **({"anomalies": sanity["anomalies"]}
+               if not sanity["ok"] else {}))
+        if should_bank:
+            record(line, sanity=sanity)
+        push2.end()
+        nopush2.end()
+        if not sanity["ok"]:
+            return {"outcome": "anomaly",
+                    "anomalies": sanity["anomalies"]}
+        if mismatches:
+            return {"outcome": "anomaly",
+                    "anomalies": [f"push-mismatch:{mismatches}"]}
+        return {}
+
+    def serve_resident_case():
+        """Device-resident bulk serving on the real backend: the same
+        4-session x 4-item work list through ResidentExecutor.run_queue
+        (one device-lock hold, one end-of-queue sync, one extraction
+        per session) vs per-request scheduler dispatch.  The resident
+        arm's outputs pass the sanity guards (its maybe_corrupt site is
+        serve.resident, inside run_queue); a corrupt arm is withheld
+        from the bit-equality gate and banks quarantined."""
+        from yask_tpu.serve.registry import SessionRegistry
+        from yask_tpu.serve.scheduler import BatchScheduler
+        from yask_tpu.serve.resident import run_per_request
+        gs = 64 if plat == "tpu" else 16
+        occupancy, nsteps = 4, 4
+        rng = np.random.RandomState(17)
+        arr = (rng.rand(gs, gs, gs).astype(np.float32) - 0.5) * 0.1
+
+        reg = SessionRegistry(fac, env)
+        prof = reg.get_profile("iso3dfd", 2, str(gs), mode="jit", wf=1)
+        sched = BatchScheduler(reg, window_secs=0.0)
+
+        def open_sessions():
+            sids = []
+            for i in range(occupancy):
+                s = reg.open_session(prof)
+                sids.append(s.sid)
+                with sched.session_ctx(s.sid) as c:
+                    v = c.get_var("pressure")
+                    for t in range(v.get_first_valid_step_index(),
+                                   v.get_last_valid_step_index() + 1):
+                        v.set_elements_in_slice(
+                            arr * (i + 1), [t, 0, 0, 0],
+                            [t, gs - 1, gs - 1, gs - 1])
+            return sids
+
+        def work(sids):
+            return [(sid, t, t) for t in range(nsteps)
+                    for sid in sids]
+
+        warm = open_sessions()
+        sched.run_resident(work(warm)[:1])     # compile outside timing
+        for sid in warm:
+            reg.close_session(sid)
+
+        sids_r = open_sessions()
+        t0r = time.perf_counter()
+        res = sched.run_resident(work(sids_r))
+        t_resident = time.perf_counter() - t0r
+
+        sids_p = open_sessions()
+        t0q = time.perf_counter()
+        base = run_per_request(sched, work(sids_p))
+        t_per_req = time.perf_counter() - t0q
+        sched.shutdown()
+
+        sanity = check_output(res[sids_r[0]]["outputs"]["pressure"])
+        mismatches = 0
+        if sanity["ok"]:   # corrupt resident arm: comparison withheld
+            for sr, sp in zip(sids_r, sids_p):
+                for name, a in res[sr]["outputs"].items():
+                    if not np.array_equal(a, base[sp]["outputs"][name]):
+                        mismatches += 1
+
+        line = {"metric": f"iso3dfd r=2 {gs}^3 {plat} "
+                          "serve-resident-speedup",
+                "value": round(t_per_req / max(t_resident, 1e-12), 4),
+                "unit": "x", "platform": plat,
+                "occupancy": occupancy, "items": occupancy * nsteps,
+                "resident_secs": round(t_resident, 4),
+                "per_request_secs": round(t_per_req, 4),
+                "mismatches": mismatches}
+        log("serve_resident_ab", **line,
+            **({"anomalies": sanity["anomalies"]}
+               if not sanity["ok"] else {}))
+        if should_bank:
+            record(line, sanity=sanity)
+        if not sanity["ok"]:
+            return {"outcome": "anomaly",
+                    "anomalies": sanity["anomalies"]}
+        if mismatches:
+            return {"outcome": "anomaly",
+                    "anomalies": [f"resident-mismatch:{mismatches}"]}
+        return {}
+
     def serving_case():
         """Serving-layer batched A/B on the real backend (the serving
         stage the round-10 ROADMAP left unwritten): N tenants through
@@ -1391,10 +1566,15 @@ def main(argv=None) -> int:
         if "pipeline_fusion_ab" in stages:
             runner.run_case("pipeline_fusion_ab", "",
                             pipeline_fusion_case)
+        if "push_ab" in stages:
+            runner.run_case("push_ab", "", push_ab_case)
         if "serving" in stages:
             runner.run_case("serving", "", serving_case)
         if "serving_bucket" in stages:
             runner.run_case("serving_bucket", "", serving_bucket_case)
+        if "serve_resident_ab" in stages:
+            runner.run_case("serve_resident_ab", "",
+                            serve_resident_case)
 
         # 5b) quick sessions validate AFTER the perf stages are banked
         if quick and "validate" in stages:

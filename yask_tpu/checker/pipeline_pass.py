@@ -73,17 +73,29 @@ def check_pipeline_plan(pipe, budget=None) -> CheckReport:
 
 def _render_plan(report: CheckReport, plan) -> None:
     for r in plan.get("reasons", ()):
+        code = r.get("code")
+        det = {k: v for k, v in r.items() if k not in ("msg",)}
         if r.get("ok"):
+            # push decisions are worth surfacing even when ok: engaged
+            # means stale rings (the caller should know), ineligible
+            # explains why the HBM halving did not happen
+            if code == "pipeline-push-engaged":
+                report.add("PIPELINE-PUSH-ENGAGED", "info", r["msg"],
+                           detail=det)
+            elif code == "pipeline-push-ineligible":
+                report.add("PIPELINE-PUSH-INFEASIBLE", "info", r["msg"],
+                           detail=det)
             continue
-        if r.get("code") == "pipeline-vmem-spill":
+        if code == "pipeline-vmem-spill":
             report.add("PIPELINE-VMEM-SPILL", "error", r["msg"],
-                       detail={k: v for k, v in r.items()
-                               if k not in ("msg",)})
+                       detail=det)
+        elif code == "pipeline-push-vmem-spill":
+            report.add("PIPELINE-PUSH-VMEM-SPILL", "error", r["msg"],
+                       detail=det)
         else:
             report.add("PIPELINE-INFEASIBLE", "warn",
                        f"[{r['code']}] {r['msg']}",
-                       detail={k: v for k, v in r.items()
-                               if k not in ("msg",)})
+                       detail=det)
     if plan.get("fused"):
         det = {"fused": True, "sig": plan.get("sig"),
                "stages": plan.get("stages"),

@@ -69,7 +69,10 @@ SERVE: Tuple[str, ...] = ("SERVE-BATCH-INCOMPAT",
                           "SERVE-AUTOSCALE-BOUNDS")
 
 PIPELINE: Tuple[str, ...] = ("PIPELINE-SKIPPED", "PIPELINE-INFEASIBLE",
-                             "PIPELINE-VMEM-SPILL", "PIPELINE-ENGAGED")
+                             "PIPELINE-VMEM-SPILL", "PIPELINE-ENGAGED",
+                             "PIPELINE-PUSH-ENGAGED",
+                             "PIPELINE-PUSH-INFEASIBLE",
+                             "PIPELINE-PUSH-VMEM-SPILL")
 
 #: every structured reason code ``build_pallas_chunk`` can record —
 #: the explain pass republishes each as ``EXPLAIN-<CODE>``.  The
@@ -84,6 +87,7 @@ PLAN_REASON_CODES: Tuple[str, ...] = (
     "trapezoid_ineligible", "trapezoid_fallback", "trapezoid_diamond",
     "block_fitted", "block_shrunk",
     "pipe_in_on", "pipe_in_off", "pipe_out_on", "pipe_out_off",
+    "push_engaged", "push_ineligible", "push_disabled", "push_forced",
 )
 
 

@@ -77,6 +77,10 @@ def plan_pallas(ctx, program, budget: int):
     # the build's profit gate decide, False disables — the plan must
     # reflect the tiling the runtime would actually choose
     trz = None if getattr(opts, "trapezoid_tiling", False) else False
+    # likewise the push argument: ctx._push_arg() is the single
+    # resolution of the push_memory knob — the static plan must show
+    # the same DMA-path partition the runtime would build
+    psh = ctx._push_arg()
     if ctx._mode == "shard_pallas":
         ana = ctx._ana
         dims = ana.domain_dims
@@ -92,12 +96,12 @@ def plan_pallas(ctx, program, budget: int):
             vmem_budget=budget, skew=skw,
             vinstr_cap=opts.max_tile_vinstr, unsharded_dims=unsh,
             max_skew_dims=opts.skew_dims_max, trapezoid=trz,
-            plan_only=True)
+            push=psh, plan_only=True)
     return build_pallas_chunk(
         program, fuse_steps=K, block=blk, vmem_budget=budget,
         skew=skw, vinstr_cap=opts.max_tile_vinstr,
         max_skew_dims=opts.skew_dims_max, trapezoid=trz,
-        plan_only=True)
+        push=psh, plan_only=True)
 
 
 def _classify_plan_error(msg: str) -> str:
@@ -113,6 +117,8 @@ def _classify_plan_error(msg: str) -> str:
         return "SKEW-INFEASIBLE"
     if msg.startswith("trapezoid tiling") or "pallas diamond band" in msg:
         return "TRAPEZOID-INFEASIBLE"
+    if msg.startswith("push-memory fusion infeasible"):
+        return "PIPELINE-PUSH-INFEASIBLE"
     return "PLAN-FAILED"
 
 
@@ -143,7 +149,10 @@ def check_vmem(report: CheckReport, ctx, program) -> None:
                "in_tile_bytes": plan["in_tile_bytes"],
                "work_bytes": plan["work_bytes"],
                "carry_bytes": plan["carry_bytes"],
-               "ostage_bytes": plan["ostage_bytes"]}
+               "ostage_bytes": plan["ostage_bytes"],
+               "push": plan.get("push", False),
+               "push_vars": plan.get("push_vars", []),
+               "push_tile_bytes": plan.get("push_tile_bytes", 0)}
         if live > limit:
             report.add(
                 "VMEM-SPILL", "error",

@@ -492,6 +492,76 @@ def vmem_limit_bytes(vmem_budget: int) -> int:
     return get_capability().vmem_limit_bytes(vmem_budget)
 
 
+def push_eligible_vars(program) -> Dict[str, str]:
+    """Per written non-scratch var: ``"ok"`` when its VMEM output tile
+    can be PUSHED to its consumers inside the grid step (no input DMA,
+    no write-back — the push-memory tile-graph fusion), else the reason
+    it cannot.  THE single eligibility definition — the build, the
+    pipeline planner, and the checker's explain pass all read it.
+
+    A var is pushable exactly when every read of it anywhere in the
+    program is a same-sub-step read of the value written this sub-step
+    (step offset ``+step_dir`` — the read rides the kernel's
+    ``computed`` dict, never a ring tile), its writes are unconditional
+    over the full domain (so the in-kernel zero-seeded base tile is
+    bit-equivalent to the HBM ghost-zero pads on every cell a consumer
+    can reach), and it has at least one such reader (a never-read
+    written var is a final OUTPUT — it must stay on the write-DMA
+    path).  Full-dim, misc-free vars only: partial-dim write slabs and
+    misc-pinned writes leave base cells the zero seed cannot
+    reproduce."""
+    from yask_tpu.compiler.expr import PointVisitor
+    ana = program.ana
+    dims = ana.domain_dims
+    sd = ana.step_dir
+    # reads per var across EVERY equation (rhs + conditions, scratch
+    # eqs included): step offsets seen anywhere in the program
+    read_offs: Dict[str, set] = {}
+    writers: Dict[str, List] = {}
+    for eq in ana.eqs:
+        name = eq.lhs.var_name()
+        writers.setdefault(name, []).append(eq)
+        pv = PointVisitor()
+        eq.rhs.accept(pv)
+        if eq.cond is not None:
+            eq.cond.accept(pv)
+        if eq.step_cond is not None:
+            eq.step_cond.accept(pv)
+        for p in pv.points:
+            read_offs.setdefault(p.var_name(), set()).add(
+                p.step_offset())
+    out: Dict[str, str] = {}
+    for n in sorted(program.geoms):
+        g = program.geoms[n]
+        if not g.is_written or g.is_scratch:
+            continue
+        if g.domain_dims != dims:
+            out[n] = ("partial-dim written var (zero-seeded base tile "
+                      "cannot reproduce the repeated-write slab)")
+            continue
+        if any(kind == "misc" for _dn, kind in g.axes):
+            out[n] = ("misc axes (unwritten misc slices would read the "
+                      "zero seed instead of the HBM values)")
+            continue
+        offs = read_offs.get(n, set())
+        if not offs:
+            out[n] = "never read (final output stays on the DMA path)"
+            continue
+        if offs != {sd}:
+            bad = sorted(o if o is not None else 0
+                         for o in offs if o != sd)
+            out[n] = (f"read at step offsets {bad} (ring/same-level "
+                      "reads need the HBM ring state)")
+            continue
+        if any(eq.cond is not None or eq.step_cond is not None
+               for eq in writers.get(n, [])):
+            out[n] = ("conditional write (unselected cells keep the "
+                      "base tile, which a pushed var seeds with zeros)")
+            continue
+        out[n] = "ok"
+    return out
+
+
 def build_pallas_chunk(program, fuse_steps: int = 1,
                        block: Optional[Tuple[int, ...]] = None,
                        interpret: bool = False,
@@ -507,6 +577,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                        reasons: Optional[List[dict]] = None,
                        region: Optional[Dict[str, Tuple[int, int]]] = None,
                        trapezoid=False,
+                       push=False,
                        _diamond: Optional[dict] = None):
     """Build ``chunk(state, t0) -> state`` advancing ``fuse_steps`` steps
     in one fused Pallas sweep.
@@ -594,6 +665,24 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     only.  ``_diamond`` is the internal fill-pass parametrization (the
     build recurses once per trapezoid dim); its chunk returns raw
     per-boundary band arrays the outer chunk stitches host-side.
+
+    ``push`` selects the push-memory tile-graph fusion: an eligible
+    intermediate var's VMEM output tile is consumed by its reader
+    stages inside the grid step (the kernel's ``computed`` dict already
+    carries it) and the var leaves BOTH HBM paths — its input tiles are
+    never DMA'd in and its outputs never written back, so each K-group
+    saves one full read + one full write of the var (the pipeline HBM
+    model's 48→24 bytes/pt halving on the RTM chain).  Eligibility is
+    :func:`push_eligible_vars` (every read program-wide at step offset
+    ``+step_dir``, unconditional full-dim misc-free writes, ≥ 1
+    reader); trapezoid/diamond builds decline (the fill pass recomputes
+    from level-0 HBM state a pushed var no longer has) and so do
+    distributed builds (scope: single device).  ``False`` = off (the
+    default — a pushed var's HBM ring goes STALE, so plain solutions
+    keep every var observable); ``None`` = auto-engage every eligible
+    var (the pipeline runtime's fused path); ``True`` = force (raises
+    when nothing is eligible); a list = force exactly those vars
+    (raising on any ineligible name).
     """
     import jax
     import jax.numpy as jnp
@@ -829,7 +918,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             stream_unsharded=stream_unsharded,
             unsharded_dims=unsharded_dims,
             max_skew_dims=max_skew_dims, plan_only=plan_only,
-            reasons=reasons, region=region or None, trapezoid=False)
+            reasons=reasons, region=region or None, trapezoid=False,
+            push=push_req)
 
     if isinstance(skew, (list, tuple, set, frozenset)) and not skew:
         skew = False   # an explicit empty dim list = uniform shrink
@@ -901,6 +991,71 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
                         "detail": ("trapezoid engaged (parallel grid "
                                    "excludes carries)" if trap_dims
                                    else "skew=False requested")})
+
+    # ---- push-memory resolution ----------------------------------------
+    # Same gate shape as skew/trapezoid: False = off, None = auto-engage
+    # every eligible var, True/list = force (raise when infeasible).
+    # Pushed vars leave BOTH HBM paths (no input DMA, no write-back);
+    # their rings in the returned state are STALE — only the pipeline
+    # runtime, which hides bound intermediates, turns this on.
+    push_req = push
+    if isinstance(push, (list, tuple, set, frozenset)) and not push:
+        push = False
+    push_forced = push is True or isinstance(push, (list, tuple, set,
+                                                    frozenset))
+    pushed: List[str] = []
+    if push is False:
+        reasons.append({"code": "push_disabled",
+                        "detail": "push=False requested"})
+    else:
+        push_block = ("trapezoid/diamond build (the fill pass "
+                      "recomputes from level-0 HBM state)"
+                      if (trap_dims or _diamond is not None)
+                      else "distributed build (scope: single device)"
+                      if distributed else None)
+        elig_push = ({} if push_block is not None
+                     else push_eligible_vars(program))
+        if push_forced:
+            want_p = (sorted(n for n, why in elig_push.items()
+                             if why == "ok")
+                      if push is True else sorted(set(push)))
+            bad_p = [n for n in want_p
+                     if elig_push.get(n, "not a written non-scratch "
+                                      "var of this program") != "ok"]
+            if push_block is not None or bad_p or not want_p:
+                if push_block is not None:
+                    why_p = push_block
+                elif bad_p:
+                    why_p = "; ".join(
+                        f"'{n}': {elig_push.get(n, 'unknown var')}"
+                        for n in bad_p)
+                else:
+                    why_p = f"no eligible vars (candidates: {elig_push})"
+                raise YaskException(
+                    f"push-memory fusion infeasible: {why_p}")
+            pushed = want_p
+            reasons.append({"code": "push_forced", "vars": list(pushed)})
+        else:   # auto
+            if push_block is not None:
+                reasons.append({"code": "push_ineligible",
+                                "detail": push_block})
+            else:
+                for n in sorted(elig_push):
+                    if elig_push[n] == "ok":
+                        pushed.append(n)
+                        reasons.append({"code": "push_engaged",
+                                        "var": n,
+                                        "detail": "all reads at "
+                                                  "+step_dir ride the "
+                                                  "in-step computed "
+                                                  "tile"})
+                    else:
+                        reasons.append({"code": "push_ineligible",
+                                        "var": n,
+                                        "detail": elig_push[n]})
+    pushed_set = set(pushed)
+    use_push = bool(pushed)
+
     R = dict(rad)
     # Misaligned (non-sublane-multiple) stream radii: every skewed
     # region carries E_sk extra computed width on its right so the
@@ -925,6 +1080,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # planned must be rejected here (the auto-tuner relies on this to
     # skip infeasible candidates).
     for n, g in program.geoms.items():
+        if n in pushed_set:
+            continue  # pushed vars have no HBM DMA windows to cover
         for d in lead:
             if d not in g.domain_dims:
                 continue  # partial-dim var lacks this axis
@@ -970,6 +1127,11 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
 
     non_scratch_geoms = [g for g in program.geoms.values()
                          if not g.is_scratch]
+    # pushed vars have no HBM windows: they neither constrain the
+    # right-edge overshoot nor the pad coverage (block sublane
+    # alignment still honors every non-scratch geom — conservative)
+    window_geoms = [g for g in non_scratch_geoms
+                    if g.name not in pushed_set]
 
     # In the diamond fill pass one dim's grid walks tile BOUNDARIES:
     # its tiles are band-wide (block = 2·half) but advance by the
@@ -1009,7 +1171,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         right pad; every var's allocation must contain it."""
         gcount = _gcount(d, b)
         st = _diamond["stride"] if d == dd else b
-        for g in non_scratch_geoms:
+        for g in window_geoms:
             if d not in g.domain_dims:
                 continue
             if g.origin[d] + _goff(d) < 0:
@@ -1061,7 +1223,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             vinstr_cap=vinstr_cap, stream_unsharded=stream_unsharded,
             unsharded_dims=unsharded_dims,
             max_skew_dims=max(len(skew_dims) - 1, 0),
-            plan_only=plan_only, reasons=reasons, region=region or None)
+            plan_only=plan_only, reasons=reasons, region=region or None,
+            push=push_req)
 
     try:
         _block_req = dict(block)
@@ -1088,7 +1251,12 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # SMEM and are read by static scalar indexing — no DMA, no VMEM tile
     smem_vars = {n for n in var_order
                  if not program.geoms[n].domain_dims}
-    dma_vars = [n for n in var_order if n not in smem_vars]
+    # pushed vars ride neither DMA path: no input fetch (consumers read
+    # the in-step computed tile) and no write-back (their HBM rings go
+    # stale — the pipeline runtime hides them)
+    dma_vars = [n for n in var_order
+                if n not in smem_vars and n not in pushed_set]
+    written_out = [n for n in written if n not in pushed_set]
 
     base_off: Dict[Tuple[str, str], int] = {}
     resid: Dict[Tuple[str, str], int] = {}
@@ -1102,8 +1270,9 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             for d in g.domain_dims:
                 if d == minor:
                     continue
-                if g.is_scratch:
-                    # scratch tiles never touch HBM: unconstrained
+                if g.is_scratch or n in pushed_set:
+                    # scratch and pushed tiles never touch HBM:
+                    # unconstrained (no DMA window alignment)
                     base_off[n, d], resid[n, d] = 0, 0
                     slab[n, d] = block[d] + mL[d] + mR[d]
                 else:
@@ -1147,9 +1316,13 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # region's left strip, which only the neighboring tile computed:
     # awp's anelastic memory vars corrupted a radius-wide band when
     # they were left out of the carry).
+    # Pushed vars never carry: their only reads are same-sub-step
+    # ``computed`` reads, which never touch the ring tiles the carry
+    # strips patch.
     carry_vars = ([n for n in written
-                   if n in ring_read_vars
-                   or n in ana.read_var_names()]
+                   if (n in ring_read_vars
+                       or n in ana.read_var_names())
+                   and n not in pushed_set]
                   if use_skew else [])
     carr_base: Dict[Tuple[str, str], int] = {}
     for _d in skew_dims:
@@ -1175,13 +1348,19 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
 
     def _tile_bytes():
         in_b = sum(slots[n] * int(math.prod(tile_shape(n))) * esize
-                   for n in var_order if n not in smem_vars)
+                   for n in dma_vars)
         # workspace for sub-step results (rough: one extra tile per
         # written var) and the in-tile scratch values
         work_b = sum(int(math.prod(tile_shape(n))) * esize
                      for n in written)
         work_b += sum(int(math.prod(tile_shape(n))) * esize
                       for n in scratch_vars)
+        # pushed vars have no DMA scratch refs, but their ring values
+        # (zero seed → rotated computed tiles) stay LIVE across the
+        # sub-steps — one tile per slot, in the work accounting (they
+        # never double-buffer, so the pipe model must not 2× them)
+        work_b += sum(slots[n] * int(math.prod(tile_shape(n))) * esize
+                      for n in pushed)
         work_b += sum(int(math.prod(carry_shape(d_, n_))) * esize
                       for (d_, n_) in carr_base)
         return in_b, work_b
@@ -1309,7 +1488,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # busts the budget (outputs then stage through the input scratch
     # and drain at the end of each grid step).
     ostage_bytes = 2 * sum(int(math.prod(tile_shape(n))) * esize
-                           * min(K, slots[n]) for n in written)
+                           * min(K, slots[n]) for n in written_out)
     use_pipe_out = use_pipe and (2 * in_tile_bytes + work_bytes
                                  + ostage_bytes <= vmem_budget)
     if use_pipe_out:
@@ -1384,6 +1563,8 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             "total_steps": total_steps,
             "skew": bool(use_skew),
             "skew_dims": list(skew_dims),
+            "push": bool(use_push),
+            "push_vars": list(pushed),
             "trapezoid": bool(trap_dims),
             "trap_dims": list(trap_dims),
             "dimension_semantics": list(dim_sem),
@@ -1407,6 +1588,9 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             "pipeline_out": use_pipe_out,
             "in_tile_bytes": in_tile_bytes,
             "work_bytes": work_bytes,
+            "push_tile_bytes": sum(
+                slots[n] * int(math.prod(tile_shape(n))) * esize
+                for n in pushed),
             "ostage_bytes": ostage_bytes if use_pipe_out else 0,
             "carry_bytes": sum(
                 int(math.prod(carry_shape(d_, n_))) * esize
@@ -1416,6 +1600,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             "smem_vars": sorted(smem_vars),
             "dma_vars": list(dma_vars),
             "written": list(written),
+            "written_out": list(written_out),
             "scratch_vars": list(scratch_vars),
             "slots": dict(slots),
             "carry_vars": list(carry_vars),
@@ -1457,7 +1642,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         t0_ref = refs[0]
         off_ref = refs[1] if distributed else None
         ins = refs[nscalars:n_inputs]
-        nout = sum(min(K, slots[n]) for n in written)
+        nout = sum(min(K, slots[n]) for n in written_out)
         outs = refs[n_inputs:n_inputs + nout]
         n_tiles = sum(slots[n] for n in dma_vars)
         scratch = refs[n_inputs + nout:n_inputs + nout + n_tiles]
@@ -1487,7 +1672,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             see the pipelined retirement below)."""
             cps = []
             oi = 0
-            for name in written:
+            for name in written_out:
                 g = program.geoms[name]
                 nback = min(K, slots[name])
                 for s in range(nback):
@@ -1671,11 +1856,19 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         def buf_ref(si):
             return scratch[si].at[cur] if use_pipe else scratch[si]
 
-        # tiles as values; SMEM vars stay as refs (scalar static reads)
+        # tiles as values; SMEM vars stay as refs (scalar static reads).
+        # Pushed vars were never DMA'd: their ring seeds are ZERO tiles
+        # — bit-equivalent to the HBM state on every cell a consumer
+        # can reach (out-of-domain cells are ghost-zero in HBM too, and
+        # every read is a same-sub-step ``computed`` read that never
+        # touches these seeds).
         tiles: Dict[str, List] = {}
         for n in var_order:
             if n in smem_vars:
                 tiles[n] = [ins[in_base[n] + s] for s in range(slots[n])]
+            elif n in pushed_set:
+                tiles[n] = [jnp.zeros(tile_shape(n), dtype)
+                            for _ in range(slots[n])]
             else:
                 tiles[n] = [buf_ref(si_base[n] + s)[...]
                             for s in range(slots[n])]
@@ -2018,7 +2211,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         #    a later step's margin reads on real (aliasing) hardware.
 
         _oi = 0
-        for name in written:
+        for name in written_out:
             ring = tiles[name]
             nback = min(K, slots[name])
             for s in range(nback):
@@ -2054,9 +2247,10 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     # ---- pallas_call assembly -------------------------------------------
 
     # outputs are full padded arrays written by in-kernel manual DMA
+    # (pushed vars have NO outputs — their tiles die in VMEM)
     out_shapes = []
     out_specs = []
-    for name in written:
+    for name in written_out:
         g = program.geoms[name]
         oshape = list(g.shape)
         if dd is not None and dd in g.domain_dims:
@@ -2089,7 +2283,7 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
         scratch_shapes.append(pltpu.VMEM(carry_shape(d_, n_), dtype))
     # dedicated parity-doubled output staging (pipelined write-back)
     if use_pipe_out:
-        for name in written:
+        for name in written_out:
             for _ in range(min(K, slots[name])):
                 scratch_shapes.append(
                     pltpu.VMEM((2,) + tile_shape(name), dtype))
@@ -2138,9 +2332,12 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
             # fill pass: raw per-boundary band arrays — the outer
             # trapezoid chunk stitches them host-side
             return list(outs)
+        # pushed vars are ABSENT from the outputs: their rings in
+        # new_state keep the (now stale) input arrays — the pipeline
+        # runtime never exposes them, and compare/get_var guard them
         new_state = dict(state)
         oi = 0
-        for name in written:
+        for name in written_out:
             g = program.geoms[name]
             nback = min(K, slots[name])
             news = []
@@ -2250,6 +2447,11 @@ def build_pallas_chunk(program, fuse_steps: int = 1,
     chunk.tiling = {"fuse_steps": K, "block": dict(block),
                     "skew": bool(use_skew),
                     "skew_dims": list(skew_dims),
+                    "push": bool(use_push),
+                    "push_vars": list(pushed),
+                    "push_tile_bytes": sum(
+                        slots[n] * int(math.prod(tile_shape(n))) * esize
+                        for n in pushed),
                     "trapezoid": bool(trap_dims),
                     "trap_dims": list(trap_dims),
                     "dimension_semantics": list(dim_sem),

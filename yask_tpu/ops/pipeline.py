@@ -453,22 +453,59 @@ def pipeline_plan(pipe: "SolutionPipeline",
             return plan
         tile = pplan.get("tile_bytes", 0)
         limit = vmem_limit_bytes(b)
+        push_vars = list(pplan.get("push_vars") or [])
         plan["pallas"] = {"vmem_budget": b, "vmem_limit": limit,
                           "tile_bytes": tile,
                           "live_model_bytes": 2 * tile,
                           "fuse_steps": pplan.get("fuse_steps"),
                           "block": pplan.get("block"),
                           "grid": pplan.get("grid"),
-                          "skew": pplan.get("skew")}
+                          "skew": pplan.get("skew"),
+                          "push": bool(pplan.get("push")),
+                          "push_vars": push_vars,
+                          "push_tile_bytes":
+                              pplan.get("push_tile_bytes", 0)}
         if 2 * tile > limit:
-            reasons.append({"code": "pipeline-vmem-spill", "ok": False,
-                            "msg": f"live model 2x{tile} B exceeds "
-                                   f"vmem limit {limit} B (the round-3 "
-                                   f"register-spill OOM class)",
-                            "tile_bytes": tile, "vmem_limit": limit})
+            # attribute the spill to push when push tiles are what
+            # tipped the live model over — dropping them would fit
+            if push_vars and 2 * (tile - pplan.get(
+                    "push_tile_bytes", 0)) <= limit:
+                reasons.append(
+                    {"code": "pipeline-push-vmem-spill", "ok": False,
+                     "msg": f"pushed stage tiles "
+                            f"({pplan.get('push_tile_bytes', 0)} B) tip "
+                            f"the live model 2x{tile} B over the vmem "
+                            f"limit {limit} B",
+                     "tile_bytes": tile, "vmem_limit": limit,
+                     "push_vars": push_vars})
+            else:
+                reasons.append(
+                    {"code": "pipeline-vmem-spill", "ok": False,
+                     "msg": f"live model 2x{tile} B exceeds "
+                            f"vmem limit {limit} B (the round-3 "
+                            f"register-spill OOM class)",
+                     "tile_bytes": tile, "vmem_limit": limit})
             return plan
+        if push_vars:
+            reasons.append(
+                {"code": "pipeline-push-engaged", "ok": True,
+                 "msg": f"push-memory fusion: {push_vars} consumed "
+                        f"in-VMEM (no HBM round-trip)",
+                 "push_vars": push_vars})
+        else:
+            why = [r for r in pplan.get("reasons", ())
+                   if r.get("code") in ("push_ineligible",
+                                        "push_disabled")]
+            reasons.append(
+                {"code": "pipeline-push-ineligible", "ok": True,
+                 "msg": "no stage tile pushes: "
+                        + ("; ".join(
+                            f"{r.get('var', '*')}: {r['detail']}"
+                            for r in why) or "planner declined"),
+                 "detail": why})
 
-    plan["hbm_model"] = pipeline_hbm_model(pipe)
+    plan["hbm_model"] = pipeline_hbm_model(
+        pipe, push_vars=(plan.get("pallas") or {}).get("push_vars"))
     plan["fused"] = True
     reasons.append({"code": "pipeline-engaged", "ok": True,
                     "msg": f"{len(plan['stages'])}-stage chain fuses "
@@ -476,13 +513,20 @@ def pipeline_plan(pipe: "SolutionPipeline",
     return plan
 
 
-def pipeline_hbm_model(pipe: "SolutionPipeline") -> Dict:
+def pipeline_hbm_model(pipe: "SolutionPipeline", push_vars=None) -> Dict:
     """Per-point per-step HBM traffic model, chained vs fused: the
     chained arm streams every stage's read/write var set AND pays the
     binding push (one read + one write per bound var); fusion
     eliminates the bound vars entirely and streams the union once.
     Interior traffic only — margin overhead per extra stage is the
-    TilePlan ``stage_widths`` story (``docs/performance.md``)."""
+    TilePlan ``stage_widths`` story (``docs/performance.md``).
+
+    ``push_vars`` (merged ``stage__var`` names the planner's push gate
+    engaged, from ``plan["pallas"]["push_vars"]``) extends the model
+    with ``fused_push_bytes_pp``: a pushed var is consumed in-VMEM, so
+    its HBM write-back leaves the fused traffic too (its consumer reads
+    were already dropped with the bound vars).  Always present —
+    equal to ``fused_bytes_pp`` when nothing pushes."""
     eb = 4
     for _s, soln in pipe.stages:
         eb = soln._settings.elem_bytes or eb
@@ -497,9 +541,14 @@ def pipeline_hbm_model(pipe: "SolutionPipeline") -> Dict:
         f_reads = {v for v in reads if (s, v) not in bound}
         fused += (len(f_reads) + len(writes)) * eb
     chained += 2 * eb * len(pipe.bindings)
+    n_push = len(push_vars or ())
+    fused_push = max(fused - n_push * eb, eb)
     return {"elem_bytes": eb, "chained_bytes_pp": chained,
             "fused_bytes_pp": fused,
-            "ratio": (chained / fused) if fused else 0.0}
+            "ratio": (chained / fused) if fused else 0.0,
+            "push_vars": sorted(push_vars or ()),
+            "fused_push_bytes_pp": fused_push,
+            "push_ratio": (chained / fused_push) if fused_push else 0.0}
 
 
 # ---------------------------------------------------------------------------
@@ -679,10 +728,22 @@ class SolutionPipeline:
 
     # -- state access --------------------------------------------------
 
+    def pushed_vars(self) -> set:
+        """Merged ``stage__var`` names the planner's push-memory gate
+        engaged for the prepared fused arm (empty host-chained, or on
+        any mode without a pallas plan).  Pushed vars are consumed
+        in-VMEM — their HBM rings go STALE after ``run()`` and must not
+        be read or compared."""
+        if not self._prepared or not self._fused or not self._plan:
+            return set()
+        return set((self._plan.get("pallas") or {})
+                   .get("push_vars") or ())
+
     def get_var(self, stage: str, var: str):
         """The authoritative ``yk_var`` for ``stage.var`` in whichever
         arm is prepared.  Bound consumer inputs do not exist fused
-        (they were eliminated); init the producer instead."""
+        (they were eliminated); init the producer instead.  Push-fused
+        intermediates raise: their rings are stale by design."""
         self._check_prepared()
         if self._fused:
             for b in self.bindings:
@@ -691,7 +752,15 @@ class SolutionPipeline:
                         f"{stage}.{var} is a bound input eliminated by "
                         f"fusion; it is fed by "
                         f"{b.producer_stage}.{b.producer_var}")
-            return self._fused_ctx.get_var(f"{stage}{SEP}{var}")
+            mname = f"{stage}{SEP}{var}"
+            if mname in self.pushed_vars():
+                raise YaskException(
+                    f"{stage}.{var} is push-fused: its tiles are "
+                    f"consumed in-VMEM and never written back to HBM, "
+                    f"so the ring is stale after run(); read the final "
+                    f"stage's outputs, or prepare with push off "
+                    f"(-push off)")
+            return self._fused_ctx.get_var(mname)
         return self._stage_ctxs[stage].get_var(var)
 
     @property
@@ -791,8 +860,13 @@ class SolutionPipeline:
         self._check_prepared()
         other._check_prepared()
         bad = 0
+        # push-fused intermediates have stale rings in whichever arm
+        # pushed them — only vars observable in BOTH arms participate
+        skip = self.pushed_vars() | other.pushed_vars()
         for s in self.stage_names:
             for vn in self.written_vars(s):
+                if f"{s}{SEP}{vn}" in skip:
+                    continue
                 va, vb = self.get_var(s, vn), other.get_var(s, vn)
                 if va.get_step_dim_name():
                     ts = range(max(va.get_first_valid_step_index(),
@@ -824,14 +898,21 @@ class SolutionPipeline:
 # ---------------------------------------------------------------------------
 
 
-def rtm_chain(radius: int = 2):
+def rtm_chain(radius: int = 2, accumulate: bool = True):
     """The 3-stage RTM-like chain (forward acoustic step → imaging
     condition → 3-point smoothing): ``(stages, bindings)`` ready for
     :class:`SolutionPipeline` — shared by the bench A/B, the session
-    stage, tests, and the example."""
+    stage, tests, and the example.
+
+    ``accumulate=False`` swaps the imaging stage for the
+    non-accumulating ``rtm_img_pure`` (per-shot correlation, no
+    ``img(t)`` self-read): the merged image var's only reader is then
+    the smoother at ``+step_dir``, making it the push-memory fusion
+    flagship — its tile never round-trips HBM."""
     from yask_tpu.compiler.solution_base import create_solution
+    img = "rtm_img" if accumulate else "rtm_img_pure"
     stages = [("fwd", create_solution("rtm_fwd", radius=radius)),
-              ("img", create_solution("rtm_img")),
+              ("img", create_solution(img)),
               ("smooth", create_solution("rtm_smooth"))]
     bindings = [("img", "fwd_in", "fwd", "pressure"),
                 ("smooth", "img_in", "img", "img")]

@@ -182,6 +182,37 @@ SERVE_BUCKET_SPEEDUP_FLOOR = 1.5
 #: hardware once tpu_session banks the pipeline_fusion_ab stage.
 PIPELINE_FUSION_FLOOR = 1.2
 
+#: PROVISIONAL floor for the push-memory tile-graph fusion A/B
+#: (bench_suite ``pipeline-push-speedup``: the PURE rtm chain — no
+#: img(t) self-read, so the merged image var is pushable — fused with
+#: push ON vs the same fused program with ``-push off``, both at the
+#: pallas K=1 schedule where every arm is bit-exact vs the
+#: host-chained oracle).  The HBM model says the pushed var leaves
+#: BOTH HBM paths (fused 20 → fused_push 16 B/pt on this chain), but
+#: the CPU interpret proxy realizes only part of that as wall-clock
+#: (VMEM tiles are numpy copies there), so the floor sits at parity:
+#: the failure class it guards is push ENGAGING AND LOSING — a
+#: pessimization where keeping the tile in VMEM costs more than the
+#: round-trip it saves (extra seeding, margin recompute), which must
+#: never bank as a win.  Engagement itself is asserted by the section
+#: (a silent decline raises, it cannot bank 1.0×).  CPU-scoped;
+#: re-base from clean TPU rows once tpu_session banks the push_ab
+#: stage — on hardware the traffic drop is the point.
+PIPELINE_PUSH_FLOOR = 1.0
+
+#: PROVISIONAL floor for the device-resident bulk-serving A/B
+#: (bench_suite ``serve-resident-speedup``: the same 4-session x
+#: 4-item work list drained by ResidentExecutor.run_queue — one
+#: device-lock hold, one end-of-queue sync, one extraction per
+#: session — vs per-request scheduler dispatch).  The acceptance bar
+#: is "strictly faster at occupancy >= 4"; measured CPU rows sit at
+#: 4–6×.  1.5 flags the failure class — the resident path regrowing
+#: per-item synchronization (a block_until_ready or host extraction
+#: sneaking into the item loop) — without tripping on scheduler-window
+#: jitter.  Responses are bit-gated identical across arms before the
+#: row banks.  CPU-scoped; re-base on hardware.
+SERVE_RESIDENT_FLOOR = 1.5
+
 #: PROVISIONAL floor for the load harness's goodput fraction
 #: (tools/load_harness.py ``load-goodput``: completed-ok responses /
 #: offered requests on a seeded open-loop run, unit "x" so the
@@ -232,6 +263,14 @@ DEFAULT_RULES: List[GuardRule] = [
     GuardRule(name="pipeline-fusion-floor",
               pattern="pipeline-fusion",
               floor=PIPELINE_FUSION_FLOOR, rel_tol=0.25,
+              platforms=("cpu",)),
+    GuardRule(name="pipeline-push-floor",
+              pattern="pipeline-push",
+              floor=PIPELINE_PUSH_FLOOR, rel_tol=0.25,
+              platforms=("cpu",)),
+    GuardRule(name="serve-resident-floor",
+              pattern="serve-resident",
+              floor=SERVE_RESIDENT_FLOOR, rel_tol=0.25,
               platforms=("cpu",)),
     GuardRule(name="load-goodput-floor",
               pattern="load-goodput",

@@ -503,8 +503,58 @@ class AutoTuner:
 
         best_k = self._walk_ladder(walk_one, lead)
         self._trapezoid_ab(best_k)
+        self._push_ab(best_k)
         self._pipeline_ab(best_k)
         return best_k
+
+    def _push_ab(self, kw: int) -> None:
+        """Push-memory fusion on/off at the winning (K, blocks, vmem)
+        point — the same final-axis shape as the trapezoid arm.  Only
+        when the configured ``push_memory`` knob resolves to a live
+        push argument AND the planner actually engages a push at the
+        winning point (otherwise both arms compile the same kernel);
+        the losing arm pins ``push_memory`` so production compiles
+        follow the measurement."""
+        ctx = self.ctx
+        if ctx._push_arg() is False:
+            return
+        kw = max(kw, 1)
+        lead = ctx._ana.domain_dims[:-1]
+        blkw = tuple(ctx._opts.block_sizes[d] for d in lead)
+        # 0 = unset: plan at the effective default budget, not 0 MiB
+        mbw = ctx._opts.vmem_budget_mb or (ctx.vmem_budget() >> 20)
+        try:
+            plan = self._plan_signature(kw, blkw, mbw)
+            import json
+            engaged = (plan is not None
+                       and json.loads(plan).get("push", False))
+        except Exception:  # noqa: BLE001
+            engaged = False
+        if not engaged:
+            return
+        rates = {}
+        saved = ctx._opts.push_memory
+        arms = {False: "off", True: saved}
+        try:
+            for on in (False, True):
+                ctx._opts.push_memory = arms[on]
+
+                def mk():
+                    return ctx._get_pallas_chunk(kw)
+
+                rates[on] = self._measure(("push", kw, blkw, mbw, on),
+                                          mk, k=kw)
+        finally:
+            ctx._opts.push_memory = saved
+        r_on = rates.get(True, float("inf"))
+        r_off = rates.get(False, float("inf"))
+        if r_on == float("inf") and r_off == float("inf"):
+            return
+        win = r_on < r_off
+        ctx._opts.push_memory = saved if win else "off"
+        ctx._env.trace_msg(
+            f"auto-tuner: push={'on' if win else 'off'} "
+            f"(on {r_on * 1e3:.3f} vs off {r_off * 1e3:.3f} ms/step)")
 
     def _trapezoid_ab(self, kw: int) -> None:
         """Trapezoid on/off as the final axis of the single-device joint

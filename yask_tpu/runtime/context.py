@@ -1034,10 +1034,36 @@ class StencilContext:
         # toggling them must never alias another schedule's executable
         cmo = getattr(o, "comm_order", "")
         col = getattr(o, "coalesce", "auto")
+        # push-memory fusion changes which vars ride the DMA paths, so
+        # push variants must never alias each other's executables
+        psh = self._push_arg()
         # pipeline-fusion signature: a merged producer→consumer chain
         # compiles a different kernel than any standalone solution
         psig = self._pipeline_sig or ""
-        return (skw, sdm, o.vmem_budget_mb, ovx, trz, cmo, col, psig)
+        return (skw, sdm, o.vmem_budget_mb, ovx, trz, cmo, col, psh,
+                psig)
+
+    def _push_arg(self):
+        """The ``build_pallas_chunk(push=)`` argument the configured
+        ``push_memory`` setting resolves to — single definition shared
+        with the checker's ``plan_pallas`` so the static plan and the
+        executed build can never disagree.  ``auto`` engages only for
+        pipeline-fused contexts: a plain solution's user expects every
+        written var observable after ``run()``, a pipeline hides its
+        pushed intermediates behind :meth:`SolutionPipeline.get_var`."""
+        pm = getattr(self._opts, "push_memory", "auto")
+        if pm == "off":
+            return False
+        if pm == "on":
+            return None
+        if pm == "force":
+            return True
+        if pm != "auto":
+            from yask_tpu.utils.exceptions import YaskException
+            raise YaskException(
+                f"bad -push value '{pm}': expected auto|on|force|off")
+        return None if getattr(self, "_pipeline", None) is not None \
+            else False
 
     def comm_plan(self, K: Optional[int] = None):
         """The communication schedule (CommPlan) for the configured
@@ -1081,7 +1107,8 @@ class StencilContext:
                 vinstr_cap=self._opts.max_tile_vinstr,
                 max_skew_dims=self._opts.skew_dims_max,
                 trapezoid=(None if self._opts.trapezoid_tiling
-                           else False))
+                           else False),
+                push=self._push_arg())
             self._state_to_device()
             t0c = time.perf_counter()
             if interp:

@@ -92,6 +92,17 @@ class KernelSettings:
         # skewed wavefront (carries need a sequential grid).  Pads are
         # planned with the diamond band room when enabled.
         self.trapezoid_tiling = False
+        # Push-memory tile-graph fusion on the pallas path (the
+        # Halide-to-push-memory dataflow idea, arxiv 2105.12858): an
+        # eligible intermediate var's VMEM output tile is consumed by
+        # its reader stages inside the SAME grid step and the var
+        # leaves both HBM paths (no input DMA, no write-back DMA) —
+        # its HBM ring goes stale by design.  "auto" = engage for
+        # pipeline-fused contexts only (plain solutions keep every var
+        # observable), "on" = auto-engage eligible vars on any pallas
+        # context, "force" = raise when nothing is eligible,
+        # "off" = never.
+        self.push_memory = "auto"
         # Overlapped halo exchange on the shard_pallas path: split each
         # fused K-group into a core chunk (interior shrunk by radius×K
         # per sharded dim, evaluated against PRE-exchange state so XLA
@@ -243,6 +254,12 @@ class KernelSettings:
             "on the pallas path (parallel grid; auto-engaged via the "
             "TilePlan profit gate when enabled).", self,
             "trapezoid_tiling")
+        parser.add_string_option(
+            "push", "Push-memory tile-graph fusion on the pallas path: "
+            "auto|on|force|off (eligible intermediate tiles are "
+            "consumed in-VMEM and skip HBM entirely; their rings go "
+            "stale — auto engages only for pipeline-fused contexts).",
+            self, "push_memory")
         parser.add_string_option(
             "overlap_x", "shard_pallas overlapped halo exchange: "
             "auto|on|off (core/shell split of the fused K-group; the "

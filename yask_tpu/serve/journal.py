@@ -43,6 +43,12 @@ SERVE_TERMINAL = ("ok", "anomaly", "rejected")
 
 SERVE_EVENTS = ("received", "batched", "ok", "anomaly", "rejected",
                 "fault", "degraded", "stream", "preempted",
+                # resident bulk path (yask_tpu/serve/resident.py):
+                # resident_queue = a device-resident work list started
+                # (detail: item count, session set), resident_done =
+                # one touched session's outputs extracted after the
+                # single end-of-queue sync.
+                "resident_queue", "resident_done",
                 # fleet supervision lifecycle (front-side journal):
                 # worker_dead = a worker was declared dead/unhealthy,
                 # failover = a session migrated (detail: dead worker

@@ -308,6 +308,25 @@ class BatchScheduler:
                     ctx.set_run_state(prev)
         return _swap()
 
+    def run_resident(self, items, outputs=(), deadline_secs=None):
+        """Opt-in bulk path: drain a work list of (session, first,
+        last) items through the device-resident executor
+        (:mod:`yask_tpu.serve.resident`) under THIS scheduler's device
+        lock and journal — one sync for the whole queue instead of
+        per-request dispatch.  Serializes against in-flight request
+        traffic (the one-worker-owns-the-device invariant holds);
+        returns {session: {"outputs": ..., "items": n, "run_secs": s}}.
+        """
+        from yask_tpu.serve.resident import ResidentExecutor
+        with self._lock:
+            ex = getattr(self, "_resident", None)
+            if ex is None:
+                ex = self._resident = ResidentExecutor(
+                    self._registry, journal=self._journal,
+                    dev_lock=self._dev_lock)
+        return ex.run_queue(items, outputs=outputs,
+                            deadline_secs=deadline_secs)
+
     def shutdown(self, timeout: float = 10.0) -> None:
         with self._cond:
             self._shutdown = True

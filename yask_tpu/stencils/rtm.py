@@ -88,6 +88,32 @@ class RtmImagingStencil(yc_solution_base):
 
 
 @register_solution
+class RtmImagingPureStencil(yc_solution_base):
+    """'rtm_img_pure': NON-accumulating imaging condition — the image
+    is the squared source wavefield of the current shot step, with no
+    ``img(t)`` self-read.  This is the push-memory flagship variant:
+    in the merged chain every read of ``img__img`` is the smoothing
+    stage's ``+1`` read, so the fused kernel can PUSH the image tile
+    straight into the smoother and skip its HBM round-trip entirely
+    (the accumulating ``rtm_img`` ring-reads itself and must keep its
+    HBM state).  Physically this is the per-shot correlation before
+    stacking — drivers that stack host-side use exactly this form."""
+
+    def __init__(self, name: str = "rtm_img_pure"):
+        super().__init__(name)
+
+    def define(self):
+        t = self.new_step_index("t")
+        x = self.new_domain_index("x")
+        y = self.new_domain_index("y")
+        z = self.new_domain_index("z")
+        img = self.new_var("img", [t, x, y, z])
+        fwd = self.new_var("fwd_in", [x, y, z])
+
+        img(t + 1, x, y, z).EQUALS(fwd(x, y, z) * fwd(x, y, z))
+
+
+@register_solution
 class RtmSmoothStencil(yc_solution_base):
     """'rtm_smooth': 3-point box average of the image per dim (the
     post-imaging low-pass every RTM driver applies).  ``img_in`` is the

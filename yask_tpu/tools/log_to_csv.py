@@ -82,15 +82,21 @@ def logs_to_csv(paths: List[str], out=None) -> None:
 
 
 #: Ledger columns, identity → value → verdict → roofline →
-#: attribution → provenance.  ``trace_id`` joins back to the span
-#: file; ``attr_shares`` / ``attr_root_secs`` flatten the
-#: source:"attribution" rows (empty on every other source).
+#: attribution → push/resident → provenance.  ``trace_id`` joins back
+#: to the span file; ``attr_shares`` / ``attr_root_secs`` flatten the
+#: source:"attribution" rows (empty on every other source); the
+#: ``push_*`` / ``resident_*`` columns flatten the pipeline-push and
+#: serve-resident A/B rows (model bytes/point, per-arm seconds,
+#: achieved bandwidth, queue occupancy — empty elsewhere).
 LEDGER_COLS = [
     "key", "value", "unit", "platform", "source", "measured_at",
     "trace_id",
     "guard_status", "guard_baseline", "guard_remeasured",
     "roofline_frac", "hbm_gbps", "hbm_bytes_pp",
     "attr_shares", "attr_root_secs",
+    "push_vars", "push_bytes_pp", "push_ratio", "push_secs",
+    "achieved_gbs_push", "achieved_gbs_fused", "achieved_gbs_chained",
+    "occupancy", "resident_secs", "per_request_secs",
     "git_sha", "load1", "ncpu", "calib_gpts", "cpu_model",
     "device_kind", "jax", "env_fp",
 ]
@@ -114,6 +120,8 @@ def ledger_to_csv(path: str = "", out=None) -> int:
         load = prov.get("loadavg") or [None]
         shares = (extra.get("shares")
                   if r.get("source") == "attribution" else None)
+        hbm_model = extra.get("hbm_bytes_model") or {}
+        push_vars = extra.get("push_vars")
         w.writerow({
             **{k: r.get(k) for k in ("key", "value", "unit", "platform",
                                      "source", "measured_at",
@@ -122,6 +130,17 @@ def ledger_to_csv(path: str = "", out=None) -> int:
                             if shares else None),
             "attr_root_secs": (extra.get("root_secs")
                                if shares else None),
+            "push_vars": (json.dumps(push_vars)
+                          if push_vars else None),
+            "push_bytes_pp": hbm_model.get("fused_push_bytes_pp"),
+            "push_ratio": hbm_model.get("push_ratio"),
+            "push_secs": extra.get("push_secs"),
+            "achieved_gbs_push": extra.get("achieved_gbs_push"),
+            "achieved_gbs_fused": extra.get("achieved_gbs_fused"),
+            "achieved_gbs_chained": extra.get("achieved_gbs_chained"),
+            "occupancy": extra.get("occupancy"),
+            "resident_secs": extra.get("resident_secs"),
+            "per_request_secs": extra.get("per_request_secs"),
             "guard_status": guard.get("status"),
             "guard_baseline": guard.get("baseline"),
             "guard_remeasured": guard.get("remeasured"),
